@@ -1,0 +1,22 @@
+"""petastorm_tpu: a TPU-native (JAX/XLA) data access framework with the
+capabilities of petastorm (reference ``petastorm/__init__.py:15-17``).
+
+Public API: :func:`make_reader`, :func:`make_batch_reader`,
+:class:`TransformSpec`, :class:`NoDataAvailableError`.
+"""
+
+__version__ = '0.1.0'
+
+from petastorm_tpu.errors import NoDataAvailableError  # noqa: F401
+from petastorm_tpu.transform import TransformSpec  # noqa: F401
+
+__all__ = ['make_reader', 'make_batch_reader', 'TransformSpec', 'NoDataAvailableError',
+           '__version__']
+
+
+def __getattr__(name):
+    # Lazy imports keep `import petastorm_tpu` light and avoid import cycles.
+    if name in ('make_reader', 'make_batch_reader'):
+        from petastorm_tpu import reader
+        return getattr(reader, name)
+    raise AttributeError('module {!r} has no attribute {!r}'.format(__name__, name))

@@ -1,0 +1,22 @@
+"""Exception types shared across the framework.
+
+Reference parity: ``NoDataAvailableError`` is part of the reference's top-level API
+(``petastorm/__init__.py:15-17``); metadata errors mirror
+``petastorm/etl/dataset_metadata.py:46-49``.
+"""
+
+
+class PetastormTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class NoDataAvailableError(PetastormTpuError):
+    """Raised when a reader has no row groups to read (e.g. all filtered out)."""
+
+
+class PetastormMetadataError(PetastormTpuError):
+    """Raised when dataset metadata is missing or malformed."""
+
+
+class PetastormMetadataGenerationError(PetastormTpuError):
+    """Raised when metadata generation failed validation after a dataset write."""

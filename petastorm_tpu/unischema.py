@@ -1,0 +1,321 @@
+"""Unischema: a single schema definition rendered as numpy dtypes, arrow schemas
+and stable row namedtuples.
+
+Reference parity: ``petastorm/unischema.py`` — ``UnischemaField`` (:50-69),
+``Unischema``/views/regex matching (:174-464), row encoding ``dict_to_spark_row``
+(:359-406), ``insert_explicit_nulls`` (:409), arrow inference
+``from_arrow_schema`` (:302-353) and ``_numpy_and_codec_from_arrow_type``
+(:467-502).
+
+Deviations (deliberate, TPU-first):
+ - Schemas serialize to **JSON**, not pickle — no codec-class ABI trap.
+ - Row encoding targets **pyarrow** storage types directly (``encode_row`` +
+   ``as_arrow_schema``); there is no Spark StructType path (Spark interop, when
+   needed, goes through arrow).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from collections import namedtuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.codecs import (DataframeColumnCodec, CompressedImageCodec, NdarrayCodec,
+                                  ScalarCodec, codec_from_json_dict)
+
+# Stateless default for codec-less fields; shared to keep encode_row allocation-free.
+_DEFAULT_SCALAR_CODEC = ScalarCodec()
+
+
+class UnischemaField:
+    """A single typed field: ``(name, numpy_dtype, shape, codec, nullable)``.
+
+    ``shape`` is a tuple where ``None`` entries are wildcards (variable
+    dimensions), matching the reference semantics (``unischema.py:50-69``).
+    ``codec=None`` means the value is stored natively (scalar columns in foreign
+    parquet stores).
+    """
+
+    __slots__ = ('name', 'numpy_dtype', 'shape', 'codec', 'nullable')
+
+    def __init__(self, name: str, numpy_dtype, shape: Tuple = (),
+                 codec: Optional[DataframeColumnCodec] = None, nullable: bool = False):
+        self.name = name
+        if isinstance(numpy_dtype, type) and issubclass(numpy_dtype, (str, bytes, np.str_,
+                                                                      np.bytes_)):
+            # str/bytes (and numpy subclasses) are sentinel types for variable-length
+            # string/binary columns — normalize to the plain python types.
+            self.numpy_dtype = str if issubclass(numpy_dtype, (str, np.str_)) else bytes
+        else:
+            self.numpy_dtype = np.dtype(numpy_dtype)
+        self.shape = tuple(shape)
+        self.codec = codec
+        self.nullable = bool(nullable)
+
+    def _key(self):
+        dtype_key = self.numpy_dtype if isinstance(self.numpy_dtype, type) \
+            else self.numpy_dtype.str
+        return (self.name, dtype_key, self.shape, self.codec, self.nullable)
+
+    def __eq__(self, other):
+        return isinstance(other, UnischemaField) and self._key() == other._key()
+
+    def __hash__(self):
+        return hash((self.name, self.shape, self.nullable))
+
+    def __repr__(self):
+        return 'UnischemaField({!r}, {}, {}, {}, nullable={})'.format(
+            self.name, self.numpy_dtype, self.shape, self.codec, self.nullable)
+
+    # -- JSON (de)serialization -------------------------------------------------
+
+    def to_json_dict(self) -> Dict[str, Any]:
+        if isinstance(self.numpy_dtype, type):  # str / bytes sentinel types
+            dtype_repr = {'py': self.numpy_dtype.__name__}
+        else:
+            dtype_repr = {'np': self.numpy_dtype.str}
+        return {
+            'name': self.name,
+            'dtype': dtype_repr,
+            'shape': [s if s is not None else -1 for s in self.shape],
+            'codec': self.codec.to_json_dict() if self.codec is not None else None,
+            'nullable': self.nullable,
+        }
+
+    @classmethod
+    def from_json_dict(cls, d: Dict[str, Any]) -> 'UnischemaField':
+        dtype_repr = d['dtype']
+        if 'py' in dtype_repr:
+            dtype = {'str': str, 'bytes': bytes}[dtype_repr['py']]
+        else:
+            dtype = np.dtype(dtype_repr['np'])
+        shape = tuple(s if s >= 0 else None for s in d['shape'])
+        codec = codec_from_json_dict(d['codec']) if d.get('codec') else None
+        return cls(d['name'], dtype, shape, codec, d.get('nullable', False))
+
+
+class _NamedtupleCache:
+    """Returns the same namedtuple type for identical (name, field-names) pairs,
+    so row-type identity is stable across calls (reference ``unischema.py:88-111``)."""
+
+    _store: Dict[str, Any] = {}
+
+    @classmethod
+    def get(cls, parent_name: str, field_names: Iterable[str]):
+        sorted_names = list(sorted(field_names))
+        key = ' '.join([parent_name] + sorted_names)
+        if key not in cls._store:
+            cls._store[key] = namedtuple(parent_name, sorted_names)
+        return cls._store[key]
+
+
+class Unischema:
+    """An ordered collection of :class:`UnischemaField` with view/regex support."""
+
+    def __init__(self, name: str, fields: List[UnischemaField]):
+        self._name = name
+        self._fields = {f.name: f for f in sorted(fields, key=lambda t: t.name)}
+        for f in self._fields.values():
+            setattr(self, f.name, f)
+
+    @property
+    def fields(self) -> Dict[str, UnischemaField]:
+        return self._fields
+
+    def __repr__(self):
+        fields_repr = ',\n  '.join(repr(f) for f in self._fields.values())
+        return 'Unischema({}, [\n  {}\n])'.format(self._name, fields_repr)
+
+    # -- views ------------------------------------------------------------------
+
+    def create_schema_view(self, fields) -> 'Unischema':
+        """Sub-schema from a list of ``UnischemaField`` instances and/or regex
+        pattern strings (reference ``unischema.py:199-240``)."""
+        regexes = [f for f in fields if isinstance(f, str)]
+        field_objs = [f for f in fields if isinstance(f, UnischemaField)]
+        for f in field_objs:
+            if f.name not in self._fields or self._fields[f.name] != f:
+                raise ValueError('field {} does not belong to the schema {}'.format(f, self._name))
+        matched = match_unischema_fields(self, regexes) if regexes else []
+        view_fields = {f.name: f for f in list(field_objs) + list(matched)}
+        return Unischema('{}_view'.format(self._name), list(view_fields.values()))
+
+    # -- row types --------------------------------------------------------------
+
+    def _get_namedtuple(self):
+        return _NamedtupleCache.get(self._name, self._fields.keys())
+
+    def make_namedtuple(self, **kwargs):
+        """Build a row namedtuple, casting string values for string-typed fields
+        (reference ``unischema.py:276-292``)."""
+        typed = {}
+        for key, value in kwargs.items():
+            field = self._fields[key]
+            if value is None:
+                typed[key] = None
+            elif field.numpy_dtype is str or (not isinstance(field.numpy_dtype, type)
+                                              and field.numpy_dtype.kind == 'U'):
+                typed[key] = str(value) if not isinstance(value, str) else value
+            else:
+                typed[key] = value
+        return self._get_namedtuple()(**typed)
+
+    def make_namedtuple_tf(self, *args, **kwargs):  # pragma: no cover - compat alias
+        return self._get_namedtuple()(*args, **kwargs)
+
+    # -- arrow schema / storage -------------------------------------------------
+
+    def as_arrow_schema(self) -> pa.Schema:
+        """Storage schema for the parquet files: codec-directed arrow types."""
+        pa_fields = []
+        for f in self._fields.values():
+            codec = f.codec if f.codec is not None else _DEFAULT_SCALAR_CODEC
+            pa_fields.append(pa.field(f.name, codec.arrow_type(f), nullable=f.nullable))
+        return pa.schema(pa_fields)
+
+    # -- JSON (de)serialization -------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({
+            'name': self._name,
+            'fields': [f.to_json_dict() for f in self._fields.values()],
+        })
+
+    @classmethod
+    def from_json(cls, payload: str) -> 'Unischema':
+        d = json.loads(payload)
+        return cls(d['name'], [UnischemaField.from_json_dict(fd) for fd in d['fields']])
+
+    # -- inference from foreign parquet ----------------------------------------
+
+    @classmethod
+    def from_arrow_schema(cls, arrow_schema: pa.Schema, omit_unsupported_fields: bool = True,
+                          name: str = 'inferred_schema') -> 'Unischema':
+        """Infer a Unischema for a foreign (non-petastorm) parquet store
+        (reference ``unischema.py:302-353``)."""
+        fields = []
+        for column in arrow_schema:
+            try:
+                numpy_dtype, shape, codec = _numpy_and_codec_from_arrow_type(column.type)
+            except ValueError:
+                if omit_unsupported_fields:
+                    continue
+                raise
+            fields.append(UnischemaField(column.name, numpy_dtype, shape, codec,
+                                         nullable=column.nullable))
+        return cls(name, fields)
+
+
+def _numpy_and_codec_from_arrow_type(arrow_type: pa.DataType):
+    """arrow type -> (numpy dtype, shape, codec) (reference ``unischema.py:467-502``)."""
+    import pyarrow.types as pat
+    if pat.is_int8(arrow_type):
+        return np.int8, (), None
+    if pat.is_uint8(arrow_type):
+        return np.uint8, (), None
+    if pat.is_int16(arrow_type):
+        return np.int16, (), None
+    if pat.is_uint16(arrow_type):
+        return np.uint16, (), None
+    if pat.is_int32(arrow_type):
+        return np.int32, (), None
+    if pat.is_uint32(arrow_type):
+        return np.uint32, (), None
+    if pat.is_int64(arrow_type):
+        return np.int64, (), None
+    if pat.is_uint64(arrow_type):
+        return np.uint64, (), None
+    if pat.is_float16(arrow_type):
+        return np.float16, (), None
+    if pat.is_float32(arrow_type):
+        return np.float32, (), None
+    if pat.is_float64(arrow_type):
+        return np.float64, (), None
+    if pat.is_boolean(arrow_type):
+        return np.bool_, (), None
+    if pat.is_string(arrow_type) or pat.is_large_string(arrow_type):
+        return str, (), None
+    if pat.is_binary(arrow_type) or pat.is_large_binary(arrow_type):
+        return bytes, (), None
+    if pat.is_decimal(arrow_type):
+        return np.object_, (), None
+    if pat.is_date(arrow_type) or pat.is_timestamp(arrow_type):
+        return np.datetime64, (), None
+    if pat.is_list(arrow_type) or pat.is_large_list(arrow_type):
+        inner_dtype, _, _ = _numpy_and_codec_from_arrow_type(arrow_type.value_type)
+        return inner_dtype, (None,), None
+    if pat.is_dictionary(arrow_type):
+        return _numpy_and_codec_from_arrow_type(arrow_type.value_type)
+    raise ValueError('Cannot auto-create unischema field for arrow type {}'.format(arrow_type))
+
+
+def match_unischema_fields(schema: Unischema, field_regexes: Iterable[str]) -> List[UnischemaField]:
+    """Return fields whose names fully match any of the regex patterns
+    (full-match semantics, reference ``unischema.py:437-464``)."""
+    if not field_regexes:
+        return []
+    compiled = [re.compile(p) for p in field_regexes]
+    return [f for name, f in schema.fields.items()
+            if any(c.fullmatch(name) for c in compiled)]
+
+
+def insert_explicit_nulls(schema: Unischema, row_dict: Dict[str, Any]) -> None:
+    """Insert ``None`` for missing nullable fields; raise for missing
+    non-nullable ones (reference ``unischema.py:409-434``)."""
+    for name, field in schema.fields.items():
+        if name not in row_dict:
+            if field.nullable:
+                row_dict[name] = None
+            else:
+                raise ValueError('Field {!r} is not found in the row and is not nullable'
+                                 .format(name))
+
+
+def encode_row(schema: Unischema, row_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """Codec-encode one row dict into arrow-storable cell values.
+
+    TPU-native replacement for ``dict_to_spark_row`` (reference
+    ``unischema.py:359-406``): the output feeds ``pa.Table.from_pylist`` +
+    ``pq.write_table`` instead of a Spark ``Row``.
+    """
+    if not isinstance(row_dict, dict):
+        raise TypeError('row must be a dict, got {}'.format(type(row_dict)))
+    row = dict(row_dict)
+    extra = set(row.keys()) - set(schema.fields.keys())
+    if extra:
+        raise ValueError('Following fields of row are not part of the schema: {}'.format(extra))
+    insert_explicit_nulls(schema, row)
+    encoded = {}
+    for name, field in schema.fields.items():
+        value = row[name]
+        if value is None:
+            if not field.nullable:
+                raise ValueError('Field {!r} is not nullable but got None'.format(name))
+            encoded[name] = None
+        else:
+            codec = field.codec if field.codec is not None else _DEFAULT_SCALAR_CODEC
+            encoded[name] = codec.encode(field, value)
+    return encoded
+
+
+def decode_row(row: Dict[str, Any], schema: Unischema) -> Dict[str, Any]:
+    """Decode one storage-form row dict using the schema's codecs
+    (reference ``petastorm/utils.py:52-85``)."""
+    decoded = {}
+    for name, value in row.items():
+        field = schema.fields.get(name)
+        if field is None:
+            continue
+        if value is None:
+            decoded[name] = None
+        elif field.codec is not None:
+            decoded[name] = field.codec.decode(field, value)
+        elif isinstance(field.numpy_dtype, np.dtype) and field.numpy_dtype.kind in 'biufc':
+            decoded[name] = field.numpy_dtype.type(value)
+        else:
+            decoded[name] = value
+    return decoded

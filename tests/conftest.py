@@ -1,0 +1,57 @@
+"""Shared test fixtures.
+
+Mirrors the reference's session-scoped synthetic-dataset strategy
+(``petastorm/tests/conftest.py:89-138``, ``tests/test_common.py``), but datasets
+are written with the pyarrow-native ``materialize_dataset`` instead of Spark.
+
+JAX runs on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope='session')
+def synthetic_dataset(tmp_path_factory):
+    """Full-featured petastorm_tpu dataset (images, matrices, scalars,
+    nullables) + the expected decoded rows."""
+    from petastorm_tpu.test_util.dataset_gen import create_test_dataset
+    path = tmp_path_factory.mktemp('synthetic') / 'dataset'
+    url = 'file://' + str(path)
+    data = create_test_dataset(url, range(100))
+    return SyntheticDataset(url=url, path=str(path), data=data)
+
+
+@pytest.fixture(scope='session')
+def scalar_dataset(tmp_path_factory):
+    """Scalars-only dataset (no codecs needing decode)."""
+    from petastorm_tpu.test_util.dataset_gen import create_test_scalar_dataset
+    path = tmp_path_factory.mktemp('scalar') / 'dataset'
+    url = 'file://' + str(path)
+    data = create_test_scalar_dataset(url, 100)
+    return SyntheticDataset(url=url, path=str(path), data=data)
+
+
+@pytest.fixture(scope='session')
+def non_petastorm_dataset(tmp_path_factory):
+    """A plain parquet store with no petastorm_tpu metadata (foreign store)."""
+    from petastorm_tpu.test_util.dataset_gen import create_non_petastorm_dataset
+    path = tmp_path_factory.mktemp('foreign') / 'dataset'
+    url = 'file://' + str(path)
+    data = create_non_petastorm_dataset(url, 100)
+    return SyntheticDataset(url=url, path=str(path), data=data)
+
+
+class SyntheticDataset:
+    def __init__(self, url, path, data):
+        self.url = url
+        self.path = path
+        self.data = data

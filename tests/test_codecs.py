@@ -1,0 +1,91 @@
+"""Codec unit tests (modeled on reference ``tests/test_codec_*.py``)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec, NdarrayCodec,
+                                  ScalarCodec, codec_from_json_dict)
+from petastorm_tpu.unischema import UnischemaField
+
+
+def _roundtrip(codec, field, value):
+    return codec.decode(field, codec.encode(field, value))
+
+
+class TestNdarrayCodec:
+    def test_roundtrip(self):
+        field = UnischemaField('m', np.float32, (3, None), NdarrayCodec(), False)
+        value = np.random.default_rng(0).standard_normal((3, 5)).astype(np.float32)
+        np.testing.assert_array_equal(_roundtrip(NdarrayCodec(), field, value), value)
+
+    def test_wrong_dtype_raises(self):
+        field = UnischemaField('m', np.float32, (3,), NdarrayCodec(), False)
+        with pytest.raises(ValueError, match='dtype'):
+            NdarrayCodec().encode(field, np.zeros(3, dtype=np.float64))
+
+    def test_wrong_shape_raises(self):
+        field = UnischemaField('m', np.float32, (3,), NdarrayCodec(), False)
+        with pytest.raises(ValueError, match='shape'):
+            NdarrayCodec().encode(field, np.zeros(4, dtype=np.float32))
+
+
+class TestCompressedNdarrayCodec:
+    def test_roundtrip_and_compresses(self):
+        field = UnischemaField('m', np.int64, (None, None), CompressedNdarrayCodec(), False)
+        value = np.zeros((100, 100), dtype=np.int64)
+        encoded = CompressedNdarrayCodec().encode(field, value)
+        assert len(encoded) < value.nbytes // 10
+        np.testing.assert_array_equal(CompressedNdarrayCodec().decode(field, encoded), value)
+
+
+class TestCompressedImageCodec:
+    def test_png_lossless_rgb(self):
+        field = UnischemaField('im', np.uint8, (16, 32, 3), CompressedImageCodec('png'), False)
+        value = np.random.default_rng(1).integers(0, 255, (16, 32, 3), dtype=np.uint8)
+        np.testing.assert_array_equal(_roundtrip(field.codec, field, value), value)
+
+    def test_png_lossless_grayscale(self):
+        field = UnischemaField('im', np.uint8, (16, 32), CompressedImageCodec('png'), False)
+        value = np.random.default_rng(2).integers(0, 255, (16, 32), dtype=np.uint8)
+        np.testing.assert_array_equal(_roundtrip(field.codec, field, value), value)
+
+    def test_jpeg_lossy_close(self):
+        codec = CompressedImageCodec('jpeg', quality=95)
+        field = UnischemaField('im', np.uint8, (32, 32, 3), codec, False)
+        # Smooth gradient compresses with low error
+        g = np.linspace(0, 255, 32 * 32, dtype=np.uint8).reshape(32, 32)
+        value = np.stack([g, g, g], axis=-1)
+        decoded = _roundtrip(codec, field, value)
+        assert decoded.shape == value.shape
+        assert np.abs(decoded.astype(int) - value.astype(int)).mean() < 5
+
+    def test_bad_format_raises(self):
+        with pytest.raises(ValueError):
+            CompressedImageCodec('webm')
+
+
+class TestScalarCodec:
+    def test_int_roundtrip(self):
+        field = UnischemaField('s', np.int32, (), ScalarCodec(), False)
+        assert _roundtrip(ScalarCodec(), field, np.int32(7)) == 7
+
+    def test_string_roundtrip(self):
+        field = UnischemaField('s', str, (), ScalarCodec(), False)
+        assert _roundtrip(ScalarCodec(), field, 'abc') == 'abc'
+
+    def test_rejects_arrays(self):
+        field = UnischemaField('s', np.int32, (), ScalarCodec(), False)
+        with pytest.raises(TypeError):
+            ScalarCodec().encode(field, np.zeros(3, dtype=np.int32))
+
+
+def test_json_registry_roundtrip():
+    for codec in [NdarrayCodec(), CompressedNdarrayCodec(),
+                  CompressedImageCodec('jpeg', quality=42), ScalarCodec(np.int16)]:
+        restored = codec_from_json_dict(codec.to_json_dict())
+        assert restored == codec
+
+
+def test_unknown_codec_name_raises():
+    with pytest.raises(ValueError, match='Unknown codec'):
+        codec_from_json_dict({'codec': 'nope'})

@@ -1,0 +1,43 @@
+"""TransformSpec / transform_schema tests (reference ``petastorm/transform.py``)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import ScalarCodec
+from petastorm_tpu.transform import TransformSpec, transform_schema
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+Schema = Unischema('S', [
+    UnischemaField('a', np.int64, (), ScalarCodec(), False),
+    UnischemaField('b', np.float32, (10,), None, False),
+    UnischemaField('c', str, (), ScalarCodec(), True),
+])
+
+
+def test_removed_fields():
+    ts = TransformSpec(removed_fields=['b'])
+    out = transform_schema(Schema, ts)
+    assert set(out.fields.keys()) == {'a', 'c'}
+
+
+def test_selected_fields():
+    ts = TransformSpec(selected_fields=['a'])
+    out = transform_schema(Schema, ts)
+    assert set(out.fields.keys()) == {'a'}
+
+
+def test_edit_fields_tuple_form():
+    ts = TransformSpec(edit_fields=[('d', np.float16, (2, 2), False)])
+    out = transform_schema(Schema, ts)
+    assert out.fields['d'].shape == (2, 2)
+    assert out.fields['d'].numpy_dtype == np.dtype(np.float16)
+
+
+def test_mutually_exclusive():
+    with pytest.raises(ValueError):
+        TransformSpec(removed_fields=['a'], selected_fields=['b'])
+
+
+def test_unknown_removed_field_raises():
+    with pytest.raises(ValueError, match='unknown'):
+        transform_schema(Schema, TransformSpec(removed_fields=['zzz']))

@@ -1,0 +1,128 @@
+"""Unit tests for Unischema (modeled on reference ``tests/test_unischema.py``)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.unischema import (Unischema, UnischemaField, decode_row, encode_row,
+                                     insert_explicit_nulls, match_unischema_fields)
+
+TestSchema = Unischema('TestSchema', [
+    UnischemaField('id', np.int64, (), ScalarCodec(), False),
+    UnischemaField('value', np.float64, (), ScalarCodec(), True),
+    UnischemaField('image', np.uint8, (8, 10, 3), CompressedImageCodec('png'), False),
+    UnischemaField('matrix', np.float32, (4, None), NdarrayCodec(), False),
+    UnischemaField('name', str, (), ScalarCodec(), True),
+])
+
+
+def test_fields_accessible_as_attributes():
+    assert TestSchema.id.name == 'id'
+    assert TestSchema.matrix.shape == (4, None)
+
+
+def test_create_schema_view_with_field_objects():
+    view = TestSchema.create_schema_view([TestSchema.id, TestSchema.value])
+    assert set(view.fields.keys()) == {'id', 'value'}
+
+
+def test_create_schema_view_with_regex():
+    view = TestSchema.create_schema_view(['i.*'])
+    assert set(view.fields.keys()) == {'id', 'image'}
+
+
+def test_create_schema_view_regex_is_fullmatch():
+    # 'id' must not match 'id_something' style prefixes: 'i' alone matches nothing
+    view = TestSchema.create_schema_view(['i'])
+    assert set(view.fields.keys()) == set()
+
+
+def test_create_schema_view_foreign_field_raises():
+    foreign = UnischemaField('id', np.int32, (), ScalarCodec(), False)  # dtype differs
+    with pytest.raises(ValueError, match='does not belong'):
+        TestSchema.create_schema_view([foreign])
+
+
+def test_match_unischema_fields():
+    matched = match_unischema_fields(TestSchema, ['.*a.*'])
+    assert {f.name for f in matched} == {'value', 'image', 'matrix', 'name'}
+
+
+def test_json_roundtrip():
+    payload = TestSchema.to_json()
+    restored = Unischema.from_json(payload)
+    assert set(restored.fields.keys()) == set(TestSchema.fields.keys())
+    for name, f in TestSchema.fields.items():
+        assert restored.fields[name] == f
+
+
+def test_make_namedtuple_type_identity_and_values():
+    row1 = TestSchema.make_namedtuple(id=1, value=2.0, image=None, matrix=None, name='x')
+    row2 = TestSchema.make_namedtuple(id=2, value=3.0, image=None, matrix=None, name=7)
+    assert type(row1) is type(row2)
+    assert row1.id == 1
+    assert row2.name == '7'  # string fields are coerced
+
+
+def test_insert_explicit_nulls():
+    row = {'id': 1, 'image': 'img', 'matrix': 'm'}
+    insert_explicit_nulls(TestSchema, row)
+    assert row['value'] is None and row['name'] is None
+    with pytest.raises(ValueError, match='not nullable'):
+        insert_explicit_nulls(TestSchema, {'id': 1})
+
+
+def test_encode_decode_row_roundtrip():
+    rng = np.random.default_rng(0)
+    row = {
+        'id': 42,
+        'value': 3.25,
+        'image': rng.integers(0, 255, (8, 10, 3), dtype=np.uint8),
+        'matrix': rng.standard_normal((4, 7)).astype(np.float32),
+        'name': 'hello',
+    }
+    encoded = encode_row(TestSchema, row)
+    assert isinstance(encoded['image'], bytes)
+    assert isinstance(encoded['matrix'], bytes)
+    decoded = decode_row(encoded, TestSchema)
+    np.testing.assert_array_equal(decoded['image'], row['image'])
+    np.testing.assert_array_equal(decoded['matrix'], row['matrix'])
+    assert decoded['id'] == 42 and decoded['name'] == 'hello'
+
+
+def test_encode_row_rejects_unknown_fields():
+    with pytest.raises(ValueError, match='not part of the schema'):
+        encode_row(TestSchema, {'id': 1, 'bogus': 2})
+
+
+def test_encode_row_shape_enforcement():
+    bad = {'id': 1, 'image': np.zeros((3, 3, 3), dtype=np.uint8),
+           'matrix': np.zeros((4, 2), dtype=np.float32)}
+    with pytest.raises(ValueError, match='shape'):
+        encode_row(TestSchema, bad)
+
+
+def test_as_arrow_schema_types():
+    arrow_schema = TestSchema.as_arrow_schema()
+    assert arrow_schema.field('id').type == pa.int64()
+    assert arrow_schema.field('image').type == pa.binary()
+    assert arrow_schema.field('name').type == pa.string()
+    assert arrow_schema.field('value').nullable
+
+
+def test_from_arrow_schema_inference():
+    arrow_schema = pa.schema([
+        pa.field('a', pa.int32()),
+        pa.field('b', pa.string()),
+        pa.field('c', pa.list_(pa.float64())),
+        pa.field('unsupported', pa.struct([pa.field('x', pa.int32())])),
+    ])
+    schema = Unischema.from_arrow_schema(arrow_schema)
+    assert schema.fields['a'].numpy_dtype == np.dtype(np.int32)
+    assert schema.fields['b'].numpy_dtype is str
+    assert schema.fields['c'].shape == (None,)
+    assert 'unsupported' not in schema.fields
+
+    with pytest.raises(ValueError):
+        Unischema.from_arrow_schema(arrow_schema, omit_unsupported_fields=False)
