@@ -97,6 +97,23 @@ def _check_shape(field, value: np.ndarray):
                 field.name, field.shape, value.shape))
 
 
+def _check_dtype(field, value: np.ndarray):
+    """dtype compliance; string/bytes fields match by kind since unicode/bytes
+    itemsize varies per value."""
+    declared = field.numpy_dtype
+    if declared is str:
+        ok = value.dtype.kind == 'U'
+    elif declared is bytes:
+        ok = value.dtype.kind == 'S'
+    else:
+        declared = np.dtype(declared)
+        ok = (value.dtype.kind == declared.kind if declared.kind in 'US'
+              else value.dtype == declared)
+    if not ok:
+        raise ValueError('Field {!r} expected dtype {} got {}'.format(
+            field.name, field.numpy_dtype, value.dtype))
+
+
 @register_codec
 class NdarrayCodec(DataframeColumnCodec):
     """Lossless ndarray <-> bytes via ``np.save`` (reference ``codecs.py:133-171``)."""
@@ -104,10 +121,7 @@ class NdarrayCodec(DataframeColumnCodec):
     codec_name = 'ndarray'
 
     def encode(self, unischema_field, value):
-        expected = np.dtype(unischema_field.numpy_dtype)
-        if value.dtype != expected:
-            raise ValueError('Field {!r} expected dtype {} got {}'.format(
-                unischema_field.name, expected, value.dtype))
+        _check_dtype(unischema_field, value)
         _check_shape(unischema_field, value)
         memfile = io.BytesIO()
         np.save(memfile, value)
@@ -128,10 +142,7 @@ class CompressedNdarrayCodec(DataframeColumnCodec):
     codec_name = 'compressed_ndarray'
 
     def encode(self, unischema_field, value):
-        expected = np.dtype(unischema_field.numpy_dtype)
-        if value.dtype != expected:
-            raise ValueError('Field {!r} expected dtype {} got {}'.format(
-                unischema_field.name, expected, value.dtype))
+        _check_dtype(unischema_field, value)
         _check_shape(unischema_field, value)
         memfile = io.BytesIO()
         np.savez_compressed(memfile, arr=value)
@@ -172,9 +183,7 @@ class CompressedImageCodec(DataframeColumnCodec):
 
     def encode(self, unischema_field, value):
         import cv2
-        if value.dtype != np.dtype(unischema_field.numpy_dtype):
-            raise ValueError('Field {!r} expected dtype {} got {}'.format(
-                unischema_field.name, unischema_field.numpy_dtype, value.dtype))
+        _check_dtype(unischema_field, value)
         _check_shape(unischema_field, value)
         image_bgr_or_gray = value
         if value.ndim == 3 and value.shape[2] == 3:

@@ -1,0 +1,10 @@
+"""ETL: dataset materialization, metadata, row-group discovery and indexing.
+
+Reference parity: ``petastorm/etl/`` — but Spark-free: writes go through
+pyarrow directly (``etl/dataset_metadata.py`` in the reference drives a JVM
+parquet writer via Spark; see SURVEY.md §7 step 2).
+"""
+
+from petastorm_tpu.etl.dataset_metadata import (  # noqa: F401
+    materialize_dataset, load_row_groups, get_schema, get_schema_from_dataset_url,
+    infer_or_load_unischema, RowGroupPiece)

@@ -1,0 +1,143 @@
+"""Filesystem resolution: URL -> (fsspec filesystem, path), picklable factories.
+
+Reference parity: ``petastorm/fs_utils.py`` — ``FilesystemResolver`` (:42-166),
+``get_filesystem_and_path_or_paths`` (:202-232), ``normalize_dir_url`` (:235).
+
+TPU-first deviation: instead of hand-rolled per-scheme adapters (HDFS namenode
+parsing, ``GCSFSWrapper``), resolution delegates to **fsspec**, whose
+implementations (``gcsfs``, ``s3fs``, ``adlfs``, builtin ``file``/``memory``)
+are what pyarrow's dataset API consumes directly. GCS is the first-class remote
+for TPU pods. The reference's HDFS HA failover logic (``hdfs/namenode.py``) is
+subsumed by fsspec's hdfs implementation; a retry wrapper is provided here for
+parity with ``HAHdfsClient``-style robustness.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from typing import Dict, List, Optional, Tuple, Union
+from urllib.parse import urlparse
+
+logger = logging.getLogger(__name__)
+
+#: Schemes normalized onto a canonical fsspec protocol.
+_SCHEME_ALIASES = {
+    '': 'file',
+    'file': 'file',
+    'hdfs': 'hdfs',
+    's3': 's3', 's3a': 's3', 's3n': 's3',
+    'gs': 'gcs', 'gcs': 'gcs',
+    'memory': 'memory',
+}
+
+
+def normalize_dir_url(dataset_url: str) -> str:
+    """Strip trailing slashes from a dataset directory URL
+    (reference ``fs_utils.py:235-241``)."""
+    if not isinstance(dataset_url, str):
+        raise ValueError('dataset_url must be a string, got {!r}'.format(dataset_url))
+    return dataset_url.rstrip('/')
+
+
+def normalize_dataset_url_or_urls(dataset_url_or_urls):
+    """Accept a single URL or a non-empty list of URLs
+    (reference ``reader.py:52-58``)."""
+    if isinstance(dataset_url_or_urls, (list, tuple)):
+        if not dataset_url_or_urls:
+            raise ValueError('dataset url list must be non-empty')
+        return [normalize_dir_url(u) for u in dataset_url_or_urls]
+    return normalize_dir_url(dataset_url_or_urls)
+
+
+class FilesystemFactory:
+    """Picklable callable producing a fresh fsspec filesystem — usable in spawned
+    worker processes (reference ``filesystem_factory`` concept, ``fs_utils.py:170-199``)."""
+
+    def __init__(self, protocol: str, storage_options: Optional[Dict] = None):
+        self._protocol = protocol
+        self._storage_options = dict(storage_options or {})
+
+    def __call__(self):
+        import fsspec
+        return fsspec.filesystem(self._protocol, **self._storage_options)
+
+    def __repr__(self):
+        return 'FilesystemFactory({!r})'.format(self._protocol)
+
+
+def _parse_url(url: str) -> Tuple[str, str]:
+    """URL -> (fsspec protocol, path). Scheme-less URLs are treated as local
+    paths (deviation: the reference refuses them, ``fs_utils.py:74-79``; a local
+    path default is friendlier and unambiguous on a TPU VM)."""
+    parsed = urlparse(url)
+    scheme = parsed.scheme.lower()
+    if scheme not in _SCHEME_ALIASES:
+        raise ValueError('Unsupported url scheme {!r} in {!r}. Supported: {}'.format(
+            scheme, url, sorted(s for s in _SCHEME_ALIASES if s)))
+    protocol = _SCHEME_ALIASES[scheme]
+    if protocol == 'file':
+        path = parsed.path if scheme else url
+    elif protocol in ('s3', 'gcs'):
+        path = parsed.netloc + parsed.path
+    elif protocol == 'memory':
+        # fsspec memory paths are rooted: memory://a/b -> /a/b
+        path = '/' + parsed.netloc + parsed.path if parsed.netloc else parsed.path
+    else:  # hdfs and friends keep the authority in the filesystem, path only
+        path = parsed.path
+    return protocol, path
+
+
+def get_filesystem_and_path_or_paths(url_or_urls, storage_options: Optional[Dict] = None):
+    """Resolve URL(s) to ``(filesystem, path_or_paths, filesystem_factory)``.
+
+    All URLs in a list must live on the same filesystem
+    (reference ``fs_utils.py:202-232``).
+    """
+    import fsspec
+    urls = url_or_urls if isinstance(url_or_urls, list) else [url_or_urls]
+    parsed = [_parse_url(u) for u in urls]
+    protocols = {p for p, _ in parsed}
+    if len(protocols) > 1:
+        raise ValueError('All urls must be on the same filesystem, got {}'.format(protocols))
+    protocol = parsed[0][0]
+    paths = [path for _, path in parsed]
+    factory = FilesystemFactory(protocol, storage_options)
+    fs = fsspec.filesystem(protocol, **(storage_options or {}))
+    path_or_paths = paths if isinstance(url_or_urls, list) else paths[0]
+    return fs, path_or_paths, factory
+
+
+def get_dataset_path(url: str) -> str:
+    """URL -> bare path on its filesystem (reference ``fs_utils.py:26-36``)."""
+    return _parse_url(url)[1]
+
+
+def retry_filesystem_call(func=None, *, attempts: int = 3, initial_delay_s: float = 0.1):
+    """Retry transient filesystem errors with exponential backoff.
+
+    TPU-native stand-in for the reference's HDFS namenode failover decorator
+    (``hdfs/namenode.py:146-186``): remote object stores (GCS/S3) fail
+    transiently rather than failing over, so retry-with-backoff is the
+    equivalent robustness mechanism.
+    """
+    if attempts < 1:
+        raise ValueError('attempts must be >= 1, got {}'.format(attempts))
+
+    def decorate(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            delay = initial_delay_s
+            for attempt in range(attempts):
+                try:
+                    return f(*args, **kwargs)
+                except (OSError, IOError) as e:
+                    if attempt == attempts - 1:
+                        raise
+                    logger.warning('Filesystem call %s failed (%s); retrying in %.2fs',
+                                   f.__name__, e, delay)
+                    time.sleep(delay)
+                    delay *= 2
+        return wrapper
+    return decorate(func) if func is not None else decorate

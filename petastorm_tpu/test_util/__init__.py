@@ -1,0 +1,2 @@
+"""Test utilities: synthetic dataset generators and a no-I/O reader mock
+(reference ``petastorm/test_util/``)."""

@@ -1,0 +1,105 @@
+"""Synthetic dataset generators for tests and benchmarks.
+
+Reference parity: ``petastorm/tests/test_common.py`` (``TestSchema`` :39-57,
+``create_test_dataset`` :98-297) — but written with the pyarrow-native
+``materialize_dataset`` instead of a local Spark session (SURVEY.md §4).
+
+Generators return the expected decoded rows so tests can do value-exact
+round-trip asserts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec, NdarrayCodec,
+                                  ScalarCodec)
+from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+TestSchema = Unischema('TestSchema', [
+    UnischemaField('id', np.int64, (), ScalarCodec(), False),
+    UnischemaField('id2', np.int32, (), ScalarCodec(), False),
+    UnischemaField('id_float', np.float64, (), ScalarCodec(), False),
+    UnischemaField('id_odd', np.bool_, (), ScalarCodec(), False),
+    UnischemaField('partition_key', str, (), ScalarCodec(), False),
+    UnischemaField('python_primitive_uint8', np.uint8, (), ScalarCodec(), False),
+    UnischemaField('image_png', np.uint8, (16, 8, 3), CompressedImageCodec('png'), False),
+    UnischemaField('matrix', np.float32, (8, 4, 3), NdarrayCodec(), False),
+    UnischemaField('matrix_uint16', np.uint16, (2, 3), CompressedNdarrayCodec(), False),
+    UnischemaField('matrix_nullable', np.int32, (None,), NdarrayCodec(), True),
+    UnischemaField('sensor_name', str, (1,), NdarrayCodec(), False),
+    UnischemaField('string_array_nullable', str, (None,), NdarrayCodec(), True),
+])
+
+
+def _row_for_id(i: int) -> Dict:
+    """Deterministic row content for a given id (seeded per-row)."""
+    rng = np.random.default_rng(i)
+    return {
+        'id': np.int64(i),
+        'id2': np.int32(i % 5),
+        'id_float': np.float64(i),
+        'id_odd': np.bool_(i % 2),
+        'partition_key': 'p_{}'.format(i % 10),
+        'python_primitive_uint8': np.uint8(i % 255),
+        'image_png': rng.integers(0, 255, (16, 8, 3), dtype=np.uint8),
+        'matrix': rng.standard_normal((8, 4, 3)).astype(np.float32),
+        'matrix_uint16': rng.integers(0, 2 ** 16, (2, 3), dtype='uint16').astype(np.uint16),
+        'matrix_nullable': (rng.integers(0, 100, (4,), dtype='int64').astype(np.int32)
+                            if i % 3 else None),
+        'sensor_name': np.asarray(['sensor_{}'.format(i)]),
+        'string_array_nullable': (np.asarray([str(i), 'abc']) if i % 4 else None),
+    }
+
+
+def create_test_dataset(url: str, ids, num_files: int = 4,
+                        row_group_size_mb: float = 0.002) -> List[Dict]:
+    """Materialize the full-featured ``TestSchema`` dataset; returns expected rows."""
+    ids = list(ids)
+    rows = [_row_for_id(i) for i in ids]
+    rows_per_file = max(1, (len(rows) + num_files - 1) // num_files)
+    with materialize_dataset(url, TestSchema, row_group_size_mb=row_group_size_mb,
+                             rows_per_file=rows_per_file) as writer:
+        writer.write_rows(rows)
+    return rows
+
+
+def create_test_scalar_dataset(url: str, num_rows: int, num_files: int = 2,
+                               partition_by=None) -> List[Dict]:
+    """Scalars-only petastorm_tpu dataset (reference ``create_test_scalar_dataset``)."""
+    schema = Unischema('ScalarSchema', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('int_fixed_size_list', np.int32, (), ScalarCodec(), False),
+        UnischemaField('float64', np.float64, (), ScalarCodec(), True),
+        UnischemaField('string', str, (), ScalarCodec(), True),
+    ])
+    rows = [{'id': np.int64(i),
+             'int_fixed_size_list': np.int32(i * 2),
+             'float64': np.float64(i) / 3 if i % 7 else None,
+             'string': 'hello_{}'.format(i)} for i in range(num_rows)]
+    rows_per_file = max(1, (num_rows + num_files - 1) // num_files)
+    with materialize_dataset(url, schema, rows_per_file=rows_per_file) as writer:
+        writer.write_rows(rows)
+    return rows
+
+
+def create_non_petastorm_dataset(url: str, num_rows: int, num_files: int = 2) -> List[Dict]:
+    """A plain parquet store (no ``_common_metadata``) for ``make_batch_reader`` tests."""
+    fs, path, _ = get_filesystem_and_path_or_paths(url)
+    fs.makedirs(path, exist_ok=True)
+    rows = [{'id': i, 'value': float(i) * 1.5, 'name': 'row_{}'.format(i)}
+            for i in range(num_rows)]
+    per_file = max(1, (num_rows + num_files - 1) // num_files)
+    for part, start in enumerate(range(0, num_rows, per_file)):
+        chunk = rows[start:start + per_file]
+        table = pa.Table.from_pylist(chunk)
+        with fs.open('{}/part_{:05d}.parquet'.format(path, part), 'wb') as f:
+            # Two row groups per file so row-group-granular features are exercised.
+            pq.write_table(table, f, row_group_size=max(1, len(chunk) // 2))
+    return rows

@@ -1,0 +1,103 @@
+"""ETL/materialization/metadata tests (reference ``tests/test_dataset_metadata.py``,
+``tests/test_parquet_reader.py`` metadata paths)."""
+
+import json
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.codecs import ScalarCodec
+from petastorm_tpu.errors import PetastormMetadataError
+from petastorm_tpu.etl.dataset_metadata import (ROW_GROUPS_PER_FILE_KEY, UNISCHEMA_KEY,
+                                                add_to_common_metadata, get_schema,
+                                                get_schema_from_dataset_url,
+                                                infer_or_load_unischema, load_row_groups,
+                                                materialize_dataset, read_common_metadata)
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+from petastorm_tpu.test_util.dataset_gen import TestSchema, create_test_dataset
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+def test_materialize_writes_common_metadata(synthetic_dataset):
+    fs, path, _ = get_filesystem_and_path_or_paths(synthetic_dataset.url)
+    meta = read_common_metadata(fs, path)
+    assert UNISCHEMA_KEY in meta
+    assert ROW_GROUPS_PER_FILE_KEY in meta
+    counts = json.loads(meta[ROW_GROUPS_PER_FILE_KEY].decode())
+    assert sum(counts.values()) >= 4  # multiple files, at least one rg each
+
+
+def test_get_schema_roundtrip(synthetic_dataset):
+    fs, path, _ = get_filesystem_and_path_or_paths(synthetic_dataset.url)
+    schema = get_schema(fs, path)
+    assert set(schema.fields.keys()) == set(TestSchema.fields.keys())
+    assert schema.fields['image_png'] == TestSchema.image_png
+
+
+def test_get_schema_from_dataset_url(synthetic_dataset):
+    schema = get_schema_from_dataset_url(synthetic_dataset.url)
+    assert 'matrix' in schema.fields
+
+
+def test_get_schema_raises_on_foreign_store(non_petastorm_dataset):
+    with pytest.raises(PetastormMetadataError):
+        get_schema_from_dataset_url(non_petastorm_dataset.url)
+
+
+def test_load_row_groups_from_metadata(synthetic_dataset):
+    fs, path, _ = get_filesystem_and_path_or_paths(synthetic_dataset.url)
+    pieces = load_row_groups(fs, path)
+    assert len(pieces) >= 4
+    # deterministic sorted order
+    assert pieces == sorted(pieces, key=lambda p: (p.path, p.row_group))
+    # sum of piece rows equals dataset size
+    total = 0
+    for piece in pieces:
+        pf = pq.ParquetFile(piece.path)
+        total += pf.metadata.row_group(piece.row_group).num_rows
+    assert total == len(synthetic_dataset.data)
+
+
+def test_load_row_groups_footer_fallback(non_petastorm_dataset):
+    fs, path, _ = get_filesystem_and_path_or_paths(non_petastorm_dataset.url)
+    pieces = load_row_groups(fs, path)
+    assert len(pieces) == 4  # 2 files x 2 row groups
+    assert all(p.num_rows > 0 for p in pieces)
+
+
+def test_infer_or_load_unischema_foreign(non_petastorm_dataset):
+    fs, path, _ = get_filesystem_and_path_or_paths(non_petastorm_dataset.url)
+    schema, stored = infer_or_load_unischema(fs, path)
+    assert not stored
+    assert schema.fields['id'].numpy_dtype == np.dtype(np.int64)
+    assert schema.fields['name'].numpy_dtype is str
+
+
+def test_infer_or_load_unischema_stored(synthetic_dataset):
+    fs, path, _ = get_filesystem_and_path_or_paths(synthetic_dataset.url)
+    schema, stored = infer_or_load_unischema(fs, path)
+    assert stored
+    assert schema.fields['matrix'].codec is not None
+
+
+def test_add_to_common_metadata(tmp_path):
+    url = 'file://' + str(tmp_path / 'ds')
+    create_test_dataset(url, range(10), num_files=1)
+    fs, path, _ = get_filesystem_and_path_or_paths(url)
+    add_to_common_metadata(fs, path, b'custom.key', b'custom-value')
+    meta = read_common_metadata(fs, path)
+    assert meta[b'custom.key'] == b'custom-value'
+    assert UNISCHEMA_KEY in meta  # existing keys preserved
+
+
+def test_materialize_validation_roundtrip(tmp_path):
+    url = 'file://' + str(tmp_path / 'ds2')
+    schema = Unischema('S', [UnischemaField('x', np.int64, (), ScalarCodec(), False)])
+    with materialize_dataset(url, schema) as writer:
+        writer.write_rows([{'x': np.int64(i)} for i in range(17)])
+    fs, path, _ = get_filesystem_and_path_or_paths(url)
+    pieces = load_row_groups(fs, path)
+    assert sum(1 for _ in pieces) >= 1
+    stored = get_schema(fs, path)
+    assert stored.fields['x'].numpy_dtype == np.dtype(np.int64)

@@ -1,0 +1,73 @@
+"""Filesystem resolver tests (reference ``tests/test_fs_utils.py``)."""
+
+import pickle
+
+import pytest
+
+from petastorm_tpu.fs import (FilesystemFactory, get_dataset_path,
+                              get_filesystem_and_path_or_paths, normalize_dataset_url_or_urls,
+                              normalize_dir_url, retry_filesystem_call)
+
+
+def test_normalize_dir_url():
+    assert normalize_dir_url('file:///tmp/x/') == 'file:///tmp/x'
+    with pytest.raises(ValueError):
+        normalize_dir_url(42)
+
+
+def test_normalize_url_or_urls():
+    assert normalize_dataset_url_or_urls('file:///a/') == 'file:///a'
+    assert normalize_dataset_url_or_urls(['file:///a/', 'file:///b']) == ['file:///a', 'file:///b']
+    with pytest.raises(ValueError):
+        normalize_dataset_url_or_urls([])
+
+
+def test_local_resolution(tmp_path):
+    fs, path, factory = get_filesystem_and_path_or_paths('file://' + str(tmp_path))
+    assert path == str(tmp_path)
+    assert fs.exists(str(tmp_path))
+    # factory is picklable and produces a working filesystem (for spawned workers)
+    factory2 = pickle.loads(pickle.dumps(factory))
+    assert factory2().exists(str(tmp_path))
+
+
+def test_plain_path_treated_as_local(tmp_path):
+    fs, path, _ = get_filesystem_and_path_or_paths(str(tmp_path))
+    assert path == str(tmp_path)
+    assert fs.exists(path)
+
+
+def test_mixed_filesystems_rejected():
+    with pytest.raises(ValueError, match='same filesystem'):
+        get_filesystem_and_path_or_paths(['file:///a', 's3://bucket/b'])
+
+
+def test_unknown_scheme_rejected():
+    with pytest.raises(ValueError, match='Unsupported url scheme'):
+        get_filesystem_and_path_or_paths('bogus://x')
+
+
+def test_get_dataset_path():
+    assert get_dataset_path('file:///x/y') == '/x/y'
+    assert get_dataset_path('s3://bucket/key') == 'bucket/key'
+
+
+def test_retry_filesystem_call():
+    calls = {'n': 0}
+
+    @retry_filesystem_call(attempts=3, initial_delay_s=0.001)
+    def flaky():
+        calls['n'] += 1
+        if calls['n'] < 3:
+            raise OSError('transient')
+        return 'ok'
+
+    assert flaky() == 'ok'
+    assert calls['n'] == 3
+
+    @retry_filesystem_call(attempts=2, initial_delay_s=0.001)
+    def always_fails():
+        raise OSError('permanent')
+
+    with pytest.raises(OSError):
+        always_fails()
