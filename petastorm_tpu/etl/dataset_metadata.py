@@ -25,9 +25,8 @@ import posixpath
 from concurrent.futures import ThreadPoolExecutor
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Dict, List, Optional, Tuple
 
-import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
@@ -90,18 +89,22 @@ class DatasetWriter:
     """
 
     def __init__(self, filesystem, dataset_path: str, schema: Unischema,
-                 row_group_size_mb: int = _DEFAULT_ROW_GROUP_SIZE_MB,
-                 rows_per_file: int = 100000, compression: str = 'snappy'):
+                 row_group_size_mb: float = _DEFAULT_ROW_GROUP_SIZE_MB,
+                 rows_per_file: int = 100000, file_size_mb: float = 256,
+                 compression: str = 'snappy'):
         self._fs = filesystem
         self._path = dataset_path
         self._schema = schema
-        self._row_group_bytes = row_group_size_mb * (1 << 20)
+        self._row_group_bytes = int(row_group_size_mb * (1 << 20))
         self._rows_per_file = rows_per_file
+        self._file_size_bytes = int(file_size_mb * (1 << 20))
         self._compression = compression
         self._buffer: List[Dict] = []
+        self._buffer_bytes = 0
         self._part = 0
         self._files_written: List[str] = []
-        self._row_groups_per_file: Dict[str, int] = {}
+        # filename -> list of per-row-group row counts
+        self._row_groups_per_file: Dict[str, List[int]] = {}
         self._fs.makedirs(dataset_path, exist_ok=True)
 
     @property
@@ -109,8 +112,14 @@ class DatasetWriter:
         return self._schema
 
     def write_row(self, row_dict: Dict) -> None:
-        self._buffer.append(encode_row(self._schema, row_dict))
-        if len(self._buffer) >= self._rows_per_file:
+        encoded = encode_row(self._schema, row_dict)
+        self._buffer.append(encoded)
+        # Track approximate buffered bytes so huge rows can't accumulate into an
+        # OOM before the count-based flush triggers.
+        self._buffer_bytes += sum(
+            len(v) if isinstance(v, (bytes, str)) else 8
+            for v in encoded.values() if v is not None)
+        if len(self._buffer) >= self._rows_per_file or self._buffer_bytes >= self._file_size_bytes:
             self._flush()
 
     def write_rows(self, rows) -> None:
@@ -127,6 +136,7 @@ class DatasetWriter:
             return
         table = pa.Table.from_pylist(self._buffer, schema=self._schema.as_arrow_schema())
         self._buffer = []
+        self._buffer_bytes = 0
         self._write_table(table)
 
     def _write_table(self, table: pa.Table) -> None:
@@ -139,9 +149,12 @@ class DatasetWriter:
             pq.write_table(table, f, row_group_size=rows_per_group,
                            compression=self._compression)
         self._files_written.append(filename)
-        self._row_groups_per_file[filename] = -(-table.num_rows // rows_per_group)
+        num_groups = -(-table.num_rows // rows_per_group)
+        counts = [rows_per_group] * (num_groups - 1)
+        counts.append(table.num_rows - rows_per_group * (num_groups - 1))
+        self._row_groups_per_file[filename] = counts
 
-    def close(self) -> Dict[str, int]:
+    def close(self) -> Dict[str, List[int]]:
         self._flush()
         return dict(self._row_groups_per_file)
 
@@ -216,6 +229,11 @@ def materialize_dataset(dataset_url: str, schema: Unischema,
                     'excluding them)'.format(dataset_url, len(existing)))
             for f in existing:
                 fs.rm(f)
+        # Stale metadata must die with the data files it described, so a failure
+        # mid-write cannot leave metadata pointing at a deleted layout.
+        meta_path = posixpath.join(path, _COMMON_METADATA)
+        if fs.exists(meta_path):
+            fs.rm(meta_path)
     writer = DatasetWriter(fs, path, schema, row_group_size_mb=row_group_size_mb,
                            rows_per_file=rows_per_file, compression=compression)
     yield writer
@@ -257,8 +275,12 @@ def load_row_groups(filesystem, dataset_path: str,
         for relpath in sorted(counts.keys()):
             full = posixpath.join(dataset_path, relpath)
             parts = tuple(sorted(_partition_values_from_relpath(relpath).items()))
-            for rg in range(counts[relpath]):
-                pieces.append(RowGroupPiece(path=full, row_group=rg,
+            per_group_rows = counts[relpath]
+            # Legacy int form (group count only) tolerated for robustness.
+            if isinstance(per_group_rows, int):
+                per_group_rows = [-1] * per_group_rows
+            for rg, n in enumerate(per_group_rows):
+                pieces.append(RowGroupPiece(path=full, row_group=rg, num_rows=n,
                                             partition_values=parts))
         return pieces
 
@@ -290,9 +312,9 @@ def get_schema(filesystem, dataset_path: str) -> Unischema:
     metadata = read_common_metadata(filesystem, dataset_path)
     if metadata is None:
         raise PetastormMetadataError(
-            'Could not find _common_metadata file at {}. Use '
-            'petastorm_tpu.etl.generate_metadata to add metadata to an existing '
-            'dataset, or read it with make_batch_reader.'.format(dataset_path))
+            'Could not find _common_metadata file at {}. Run '
+            '`python -m petastorm_tpu.etl.generate_metadata <url>` to add metadata to '
+            'an existing store, or read it with make_batch_reader.'.format(dataset_path))
     if UNISCHEMA_KEY not in metadata:
         raise PetastormMetadataError(
             '_common_metadata at {} does not carry a unischema (key {}). Was this '

@@ -17,7 +17,7 @@ from __future__ import annotations
 import functools
 import logging
 import time
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Dict, Optional, Tuple
 from urllib.parse import urlparse
 
 logger = logging.getLogger(__name__)
@@ -78,6 +78,11 @@ def _parse_url(url: str) -> Tuple[str, str]:
             scheme, url, sorted(s for s in _SCHEME_ALIASES if s)))
     protocol = _SCHEME_ALIASES[scheme]
     if protocol == 'file':
+        if parsed.netloc:
+            # 'file://tmp/x' would silently resolve to '/x'; catch the common typo.
+            raise ValueError(
+                'file:// URLs must use three slashes (file:///abs/path); got {!r} whose '
+                'authority component {!r} would be dropped'.format(url, parsed.netloc))
         path = parsed.path if scheme else url
     elif protocol in ('s3', 'gcs'):
         path = parsed.netloc + parsed.path

@@ -1,0 +1,37 @@
+"""Trivial workers used by pool tests (importable from spawned worker
+interpreters, unlike classes defined inside test modules)."""
+
+import numpy as np
+
+from petastorm_tpu.workers.worker_base import WorkerBase
+
+
+class SquareWorker(WorkerBase):
+    """Publishes x*x for each ventilated x."""
+
+    def process(self, x):
+        self.publish_func(x * x)
+
+
+class MultiEmitWorker(WorkerBase):
+    """Publishes `count` copies of x (tests 0..n results per item)."""
+
+    def process(self, x, count):
+        for _ in range(count):
+            self.publish_func(x)
+
+
+class FailingWorker(WorkerBase):
+    """Raises on items equal to the poison value."""
+
+    def process(self, x):
+        if x == self.args['poison']:
+            raise ValueError('poisoned item {}'.format(x))
+        self.publish_func(x)
+
+
+class ArrayWorker(WorkerBase):
+    """Publishes a numpy array; exercises non-trivial payloads over zmq."""
+
+    def process(self, n):
+        self.publish_func(np.full((n,), n, dtype=np.int64))

@@ -1,0 +1,18 @@
+"""Worker-pool protocol types (reference ``petastorm/workers_pool/__init__.py:16-26``)."""
+
+
+class EmptyResultError(Exception):
+    """Raised by ``pool.get_results()`` when the result stream is exhausted."""
+
+
+class TimeoutWaitingForResultError(Exception):
+    """Raised when no result arrived within the configured timeout."""
+
+
+class VentilatedItemProcessedMessage:
+    """Control message a worker emits after fully processing one ventilated item.
+
+    Drives the ventilated-vs-processed accounting that detects end of epoch
+    (reference ``thread_pool.py:155-176``).
+    """
+    __slots__ = ()

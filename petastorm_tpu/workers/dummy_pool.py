@@ -1,0 +1,61 @@
+"""Single-threaded pool: work executes lazily inside ``get_results()``.
+
+Reference parity: ``petastorm/workers_pool/dummy_pool.py:20-91``. Exists so
+profilers/debuggers see worker code on the caller thread, and for fully
+deterministic tests.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from petastorm_tpu.workers import EmptyResultError, VentilatedItemProcessedMessage
+
+
+class DummyPool:
+    def __init__(self, workers_count: int = 1, **_unused):
+        self._work_queue = deque()
+        self._results_queue = deque()
+        self._worker = None
+        self._ventilator = None
+
+    @property
+    def workers_count(self) -> int:
+        return 1
+
+    def start(self, worker_class, worker_args=None, ventilator=None):
+        self._worker = worker_class(0, self._results_queue.append, worker_args)
+        self._ventilator = ventilator
+        if ventilator is not None:
+            ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        self._work_queue.append((args, kwargs))
+
+    def get_results(self, timeout=None):
+        while True:
+            if self._results_queue:
+                return self._results_queue.popleft()
+            if self._work_queue:
+                args, kwargs = self._work_queue.popleft()
+                self._worker.process(*args, **kwargs)
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+                continue
+            if self._ventilator is None or self._ventilator.completed():
+                raise EmptyResultError()
+            # The ventilator thread has not filled the work queue yet.
+            time.sleep(0.001)
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+
+    def join(self):
+        if self._worker is not None:
+            self._worker.shutdown()
+
+    @property
+    def diagnostics(self):
+        return {'output_queue_size': len(self._results_queue)}

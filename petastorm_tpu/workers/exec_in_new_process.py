@@ -1,0 +1,52 @@
+"""Launch a python function in a brand-new interpreter (not a fork).
+
+Reference parity: ``petastorm/workers_pool/exec_in_new_process.py:26-69``. The
+reference avoids fork because it broke JVM-based HDFS drivers
+(``process_pool.py:15-17``); we avoid it because **libtpu must only initialize
+in the main process** — spawned clean interpreters are pinned to
+``JAX_PLATFORMS=cpu`` so a worker can never grab the TPU (SURVEY.md §7
+"hard parts").
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+
+def exec_in_new_process(func, args=(), kwargs=None) -> subprocess.Popen:
+    """Serialize ``(func, args, kwargs)`` with dill to a temp file and launch
+    ``python -m petastorm_tpu.workers.exec_in_new_process <file>``."""
+    import dill
+    fd, path = tempfile.mkstemp(prefix='petastorm_tpu_bootstrap_', suffix='.dill')
+    with os.fdopen(fd, 'wb') as f:
+        dill.dump((func, tuple(args), dict(kwargs or {})), f)
+    env = dict(os.environ)
+    # Workers stay pure-CPU: the TPU runtime belongs to the main process only.
+    env['JAX_PLATFORMS'] = 'cpu'
+    env.setdefault('PYTHONPATH', '')
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if repo_root not in env['PYTHONPATH'].split(os.pathsep):
+        env['PYTHONPATH'] = os.pathsep.join(p for p in [repo_root, env['PYTHONPATH']] if p)
+    return subprocess.Popen([sys.executable, '-m', 'petastorm_tpu.workers.exec_in_new_process',
+                             path], env=env)
+
+
+def _main():
+    import dill
+    path = sys.argv[1]
+    try:
+        with open(path, 'rb') as f:
+            func, args, kwargs = dill.load(f)
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    func(*args, **kwargs)
+
+
+if __name__ == '__main__':
+    _main()

@@ -1,0 +1,290 @@
+"""Out-of-process worker pool over ZeroMQ.
+
+Reference parity: ``petastorm/workers_pool/process_pool.py`` — three-socket
+topology PUSH(work)/PUB(control)/PULL(results) (:52-74), startup barrier
+(:200-213), multipart ``[payload, control]`` framing (:315-317, :393-404),
+slow-joiner-resistant repeated stop broadcast (:284-301), orphan monitor
+(:320-327,379-382), exception shipping (:260-263,399-405), diagnostics
+(:303-312).
+
+Workers are spawned as clean CPU-only interpreters via
+:func:`petastorm_tpu.workers.exec_in_new_process.exec_in_new_process` so the
+TPU runtime can never initialize outside the main process.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import threading
+import time
+import traceback
+from typing import Optional
+
+from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
+                                   VentilatedItemProcessedMessage)
+from petastorm_tpu.workers.exec_in_new_process import exec_in_new_process
+from petastorm_tpu.workers.serializers import PickleSerializer
+
+logger = logging.getLogger(__name__)
+
+_STARTUP_TIMEOUT_S = 20
+_SHUTDOWN_TIMEOUT_S = 10
+_LOCALHOST = 'tcp://127.0.0.1'
+
+# Control markers travelling in the second multipart frame.
+_DATA = 'DATA'
+_FINISHED = 'FINISHED'
+
+
+class _WorkerStarted:
+    def __init__(self, worker_id):
+        self.worker_id = worker_id
+
+
+class _WorkerTerminated:
+    def __init__(self, worker_id):
+        self.worker_id = worker_id
+
+
+class _WorkerError:
+    def __init__(self, exc, formatted):
+        self.exc = exc
+        self.formatted = formatted
+
+
+class ProcessPool:
+    """Process-based pool implementing the ventilate/get_results protocol."""
+
+    def __init__(self, workers_count: int, serializer=None, zmq_copy_buffers: bool = True):
+        self._workers_count = workers_count
+        self._serializer = serializer or PickleSerializer()
+        self._zmq_copy_buffers = zmq_copy_buffers
+        self._processes = []
+        self._ventilator = None
+        self._context = None
+        self._work_sender = None
+        self._control_sender = None
+        self._results_receiver = None
+        self._poller = None
+        self._stopped = False
+        self._accounting_lock = threading.Lock()
+        self._ventilated_items = 0
+        self._processed_items = 0
+        self._results_produced = 0
+        self._terminated_workers = 0
+
+    @property
+    def workers_count(self) -> int:
+        return self._workers_count
+
+    def start(self, worker_class, worker_args=None, ventilator=None):
+        import zmq
+        self._context = zmq.Context()
+        self._work_sender = self._context.socket(zmq.PUSH)
+        work_port = self._work_sender.bind_to_random_port(_LOCALHOST)
+        self._control_sender = self._context.socket(zmq.PUB)
+        control_port = self._control_sender.bind_to_random_port(_LOCALHOST)
+        self._results_receiver = self._context.socket(zmq.PULL)
+        results_port = self._results_receiver.bind_to_random_port(_LOCALHOST)
+        self._poller = zmq.Poller()
+        self._poller.register(self._results_receiver, zmq.POLLIN)
+
+        for worker_id in range(self._workers_count):
+            proc = exec_in_new_process(
+                _worker_bootstrap,
+                args=(worker_class, worker_id, worker_args, self._serializer,
+                      '{}:{}'.format(_LOCALHOST, work_port),
+                      '{}:{}'.format(_LOCALHOST, control_port),
+                      '{}:{}'.format(_LOCALHOST, results_port),
+                      os.getpid()))
+            self._processes.append(proc)
+
+        # Startup barrier: all workers must report in before we ventilate
+        # (reference process_pool.py:200-213).
+        started = 0
+        deadline = time.monotonic() + _STARTUP_TIMEOUT_S
+        while started < self._workers_count:
+            remaining_ms = max(0, (deadline - time.monotonic()) * 1000)
+            if not dict(self._poller.poll(remaining_ms)):
+                self.stop()
+                self.join()
+                raise TimeoutWaitingForResultError(
+                    'Only {}/{} workers started within {}s'.format(
+                        started, self._workers_count, _STARTUP_TIMEOUT_S))
+            _, control = self._recv_multipart()
+            if isinstance(control, _WorkerStarted):
+                started += 1
+            elif isinstance(control, _WorkerError):
+                self.stop()
+                self.join()
+                raise control.exc
+
+        self._ventilator = ventilator
+        if ventilator is not None:
+            ventilator.start()
+
+    def _recv_multipart(self):
+        payload, control_bytes = self._results_receiver.recv_multipart(
+            copy=self._zmq_copy_buffers)
+        if not self._zmq_copy_buffers:
+            payload = memoryview(payload.buffer)
+            control_bytes = control_bytes.bytes
+        return payload, pickle.loads(control_bytes)
+
+    def ventilate(self, *args, **kwargs):
+        with self._accounting_lock:
+            self._ventilated_items += 1
+        self._work_sender.send_pyobj((args, kwargs))
+
+    def _all_work_consumed(self) -> bool:
+        with self._accounting_lock:
+            counts_settled = self._ventilated_items == self._processed_items
+        if not counts_settled:
+            return False
+        if self._ventilator is not None:
+            return self._ventilator.completed()
+        return True
+
+    def get_results(self, timeout: Optional[float] = None):
+        waited = 0.0
+        while True:
+            if not dict(self._poller.poll(100)):
+                if self._all_work_consumed():
+                    raise EmptyResultError()
+                waited += 0.1
+                if timeout is not None and waited >= timeout:
+                    raise TimeoutWaitingForResultError(
+                        'No results after {:.1f}s'.format(waited))
+                self._check_workers_alive()
+                continue
+            payload, control = self._recv_multipart()
+            if isinstance(control, VentilatedItemProcessedMessage):
+                with self._accounting_lock:
+                    self._processed_items += 1
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+                continue
+            if isinstance(control, _WorkerError):
+                import sys
+                sys.stderr.write(control.formatted)
+                self.stop()
+                raise control.exc
+            if control == _DATA:
+                with self._accounting_lock:
+                    self._results_produced += 1
+                return self._serializer.deserialize(payload)
+            # _WorkerStarted duplicates / stray messages are ignored.
+
+    def _check_workers_alive(self):
+        dead = [p for p in self._processes if p.poll() not in (None, 0)]
+        if dead and not self._stopped:
+            codes = [p.returncode for p in dead]
+            self.stop()
+            raise RuntimeError('Worker process(es) died with exit codes {}'.format(codes))
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        # Repeated FINISHED broadcast beats the PUB/SUB slow-joiner race
+        # (reference process_pool.py:284-301). Drain results while waiting.
+        deadline = time.monotonic() + _SHUTDOWN_TIMEOUT_S
+        while self._terminated_workers < len(self._processes) and time.monotonic() < deadline:
+            self._control_sender.send_pyobj(_FINISHED)
+            if dict(self._poller.poll(50)):
+                try:
+                    _, control = self._recv_multipart()
+                    if isinstance(control, _WorkerTerminated):
+                        self._terminated_workers += 1
+                except Exception:  # socket closing under us mid-drain
+                    break
+
+    def join(self):
+        for proc in self._processes:
+            try:
+                proc.wait(timeout=_SHUTDOWN_TIMEOUT_S)
+            except Exception:
+                proc.kill()
+        for sock in (self._work_sender, self._control_sender, self._results_receiver):
+            if sock is not None:
+                sock.close(linger=0)
+        if self._context is not None:
+            self._context.term()
+
+    @property
+    def diagnostics(self):
+        with self._accounting_lock:
+            return {
+                'items_consumed': self._processed_items,
+                'items_produced': self._results_produced,
+                'items_inprocess': self._ventilated_items - self._processed_items,
+                'zmq_copy_buffers': self._zmq_copy_buffers,
+            }
+
+
+def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
+                      work_addr, control_addr, results_addr, parent_pid):
+    """Entry point of a spawned worker interpreter
+    (reference ``_worker_bootstrap``, ``process_pool.py:330-413``)."""
+    import zmq
+
+    # Orphan protection: if the parent dies, exit immediately
+    # (reference process_pool.py:320-327).
+    def monitor_parent():
+        while True:
+            try:
+                os.kill(parent_pid, 0)
+            except OSError:
+                os._exit(0)
+            time.sleep(1)
+
+    threading.Thread(target=monitor_parent, daemon=True).start()
+
+    context = zmq.Context()
+    work_receiver = context.socket(zmq.PULL)
+    work_receiver.connect(work_addr)
+    control_receiver = context.socket(zmq.SUB)
+    control_receiver.setsockopt(zmq.SUBSCRIBE, b'')
+    control_receiver.connect(control_addr)
+    results_sender = context.socket(zmq.PUSH)
+    results_sender.connect(results_addr)
+
+    def send(payload_bytes, control):
+        results_sender.send_multipart([payload_bytes, pickle.dumps(control)])
+
+    def publish(data):
+        send(serializer.serialize(data), _DATA)
+
+    try:
+        worker = worker_class(worker_id, publish, worker_args)
+    except Exception as e:
+        send(b'', _WorkerError(e, traceback.format_exc()))
+        return
+    send(b'', _WorkerStarted(worker_id))
+
+    poller = zmq.Poller()
+    poller.register(work_receiver, zmq.POLLIN)
+    poller.register(control_receiver, zmq.POLLIN)
+    try:
+        while True:
+            socks = dict(poller.poll())
+            if control_receiver in socks:
+                if control_receiver.recv_pyobj() == _FINISHED:
+                    break
+            if work_receiver in socks:
+                args, kwargs = work_receiver.recv_pyobj()
+                try:
+                    worker.process(*args, **kwargs)
+                except Exception as e:
+                    send(b'', _WorkerError(e, traceback.format_exc()))
+                send(b'', VentilatedItemProcessedMessage())
+    finally:
+        worker.shutdown()
+        send(b'', _WorkerTerminated(worker_id))
+        for sock in (work_receiver, control_receiver, results_sender):
+            sock.close(linger=1000)
+        context.term()

@@ -1,0 +1,40 @@
+"""Payload serializers for the process pool transport.
+
+Reference parity: ``petastorm/reader_impl/pickle_serializer.py:17-23`` and
+``arrow_table_serializer.py:18-33`` (RecordBatch IPC stream; an empty buffer
+encodes ``None``).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import pyarrow as pa
+
+
+class PickleSerializer:
+    def serialize(self, data) -> bytes:
+        return pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def deserialize(self, payload: bytes):
+        return pickle.loads(payload)
+
+
+class ArrowTableSerializer:
+    """Zero-copy-friendly serializer for ``pa.Table`` payloads using the Arrow
+    IPC stream format."""
+
+    def serialize(self, table) -> bytes:
+        if table is None:
+            return b''
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            for batch in table.to_batches():
+                writer.write_batch(batch)
+        return sink.getvalue().to_pybytes()
+
+    def deserialize(self, payload):
+        if len(payload) == 0:
+            return None
+        with pa.ipc.open_stream(pa.py_buffer(payload)) as reader:
+            return reader.read_all()

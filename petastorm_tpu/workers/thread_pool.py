@@ -1,0 +1,180 @@
+"""In-process worker pool over stdlib threading.
+
+Reference parity: ``petastorm/workers_pool/thread_pool.py`` — worker loop
+(:51-75), bounded results queue (:79), stop-aware puts (:200-214), end-of-data
+accounting (:145-176), exception shipping (:68-73), per-thread cProfile
+(:47-49,190-198), diagnostics (:219-221).
+
+This is the default pool: the hot decode path (pyarrow reads, numpy, cv2)
+releases the GIL, so threads parallelize well without process overhead.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import logging
+import pstats
+import queue
+import sys
+import threading
+import traceback
+from typing import Optional
+
+from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
+                                   VentilatedItemProcessedMessage)
+
+logger = logging.getLogger(__name__)
+
+_SENTINEL = object()
+_RESULTS_QUEUE_SIZE_DEFAULT = 50
+
+
+class _WorkerException:
+    """An exception captured on a worker, shipped with its formatted traceback."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+        self.formatted = ''.join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+
+
+class WorkerThread(threading.Thread):
+    def __init__(self, pool: 'ThreadPool', worker, profiling_enabled: bool):
+        super().__init__(daemon=True, name='petastorm-tpu-worker-{}'.format(worker.worker_id))
+        self._pool = pool
+        self._worker = worker
+        self._profiler = cProfile.Profile() if profiling_enabled else None
+
+    def run(self):
+        if self._profiler:
+            self._profiler.enable()
+        try:
+            while True:
+                item = self._pool._work_queue.get()
+                if item is _SENTINEL:
+                    break
+                args, kwargs = item
+                try:
+                    self._worker.process(*args, **kwargs)
+                except Exception as e:  # ship to consumer; keep serving
+                    logger.debug('Worker %s raised:\n%s', self._worker.worker_id,
+                                 traceback.format_exc())
+                    self._pool._put_result(_WorkerException(e))
+                self._pool._put_result(VentilatedItemProcessedMessage())
+        finally:
+            if self._profiler:
+                self._profiler.disable()
+                self._pool._collect_profile(self._profiler)
+            self._worker.shutdown()
+
+
+class ThreadPool:
+    """Thread-based pool implementing the ventilate/get_results protocol."""
+
+    def __init__(self, workers_count: int, results_queue_size: int = _RESULTS_QUEUE_SIZE_DEFAULT,
+                 profiling_enabled: bool = False):
+        self._workers_count = workers_count
+        self._work_queue: queue.Queue = queue.Queue()
+        self._results_queue: queue.Queue = queue.Queue(maxsize=results_queue_size)
+        self._profiling_enabled = profiling_enabled
+        self._profiles = []
+        self._profiles_lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._threads = []
+        self._ventilator = None
+        self._accounting_lock = threading.Lock()
+        self._ventilated_items = 0
+        self._processed_items = 0
+
+    @property
+    def workers_count(self) -> int:
+        return self._workers_count
+
+    def start(self, worker_class, worker_args=None, ventilator=None):
+        self._ventilator = ventilator
+        for worker_id in range(self._workers_count):
+            worker = worker_class(worker_id, self._put_result, worker_args)
+            thread = WorkerThread(self, worker, self._profiling_enabled)
+            self._threads.append(thread)
+            thread.start()
+        if ventilator is not None:
+            ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        with self._accounting_lock:
+            self._ventilated_items += 1
+        self._work_queue.put((args, kwargs))
+
+    def _put_result(self, item):
+        """Bounded put that gives up when the pool is stopping
+        (reference ``_stop_aware_put``, ``thread_pool.py:200-214``)."""
+        while not self._stop_event.is_set():
+            try:
+                self._results_queue.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                continue
+
+    def _all_work_consumed(self) -> bool:
+        with self._accounting_lock:
+            counts_settled = self._ventilated_items == self._processed_items
+        if not counts_settled:
+            return False
+        if self._ventilator is not None:
+            return self._ventilator.completed()
+        return True
+
+    def get_results(self, timeout: Optional[float] = None):
+        waited = 0.0
+        while True:
+            try:
+                item = self._results_queue.get(timeout=0.1)
+            except queue.Empty:
+                if self._all_work_consumed() and self._results_queue.empty():
+                    raise EmptyResultError()
+                waited += 0.1
+                if timeout is not None and waited >= timeout:
+                    raise TimeoutWaitingForResultError(
+                        'No results after {:.1f}s'.format(waited))
+                continue
+            if isinstance(item, VentilatedItemProcessedMessage):
+                with self._accounting_lock:
+                    self._processed_items += 1
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+                continue
+            if isinstance(item, _WorkerException):
+                self.stop()
+                sys.stderr.write(item.formatted)
+                raise item.exc
+            return item
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        self._stop_event.set()
+        for _ in self._threads:
+            self._work_queue.put(_SENTINEL)
+
+    def join(self):
+        for thread in self._threads:
+            thread.join(timeout=10)
+        if self._profiling_enabled and self._profiles:
+            stats = None
+            for p in self._profiles:
+                if stats is None:
+                    stats = pstats.Stats(p)
+                else:
+                    stats.add(p)
+            out = io.StringIO()
+            stats.stream = out
+            stats.sort_stats('cumulative').print_stats(30)
+            logger.info('Aggregated worker profile:\n%s', out.getvalue())
+
+    def _collect_profile(self, profiler):
+        with self._profiles_lock:
+            self._profiles.append(profiler)
+
+    @property
+    def diagnostics(self):
+        return {'output_queue_size': self._results_queue.qsize()}

@@ -1,0 +1,154 @@
+"""Ventilator: feeds work items to a pool with epoch control, per-epoch
+reshuffling and in-flight back-pressure.
+
+Reference parity: ``petastorm/workers_pool/ventilator.py`` — ``Ventilator`` ABC
+(:26-52), ``ConcurrentVentilator`` (:55-166).
+
+Deviation: shuffling uses a seedable ``np.random.Generator`` so epoch order is
+reproducible and checkpointable (the reference notes deterministic ordering
+"enables implementing piece shuffling given a seed",
+``etl/dataset_metadata.py:274-278`` — we actually do it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+import numpy as np
+
+
+class Ventilator(ABC):
+    """Base class for ventilators which put work items into a pool."""
+
+    def __init__(self, ventilate_fn):
+        self._ventilate_fn = ventilate_fn
+
+    @abstractmethod
+    def start(self):
+        """Begin ventilating."""
+
+    @abstractmethod
+    def processed_item(self):
+        """Called by the pool whenever a ventilated item completed processing."""
+
+    @abstractmethod
+    def completed(self) -> bool:
+        """True if all items (over all epochs) have been ventilated."""
+
+    @abstractmethod
+    def stop(self):
+        """Stop ventilating."""
+
+
+class ConcurrentVentilator(Ventilator):
+    """Ventilates a fixed item list from a daemon thread.
+
+    :param ventilate_fn: ``pool.ventilate``-compatible callable.
+    :param items: list of kwargs-dicts (or arbitrary picklables) to ventilate.
+    :param iterations: number of epochs; ``None`` means infinite.
+    :param randomize_item_order: reshuffle items before each epoch.
+    :param random_seed: seed for the reshuffle generator (``None`` = OS entropy).
+    :param max_ventilation_queue_size: bound on in-flight (ventilated but not yet
+        processed) items; back-pressure (reference ``ventilator.py:146-149``).
+    :param ventilation_interval_s: poll period while back-pressured.
+    """
+
+    def __init__(self, ventilate_fn, items: List, iterations: Optional[int] = 1,
+                 randomize_item_order: bool = False,
+                 random_seed: Optional[int] = None,
+                 max_ventilation_queue_size: Optional[int] = None,
+                 ventilation_interval_s: float = 0.01,
+                 start_epoch: int = 0):
+        super().__init__(ventilate_fn)
+        if iterations is not None and iterations < 1:
+            raise ValueError('iterations must be positive or None, got {}'.format(iterations))
+        self._items = list(items)
+        self._iterations_remaining = iterations
+        self._randomize_item_order = randomize_item_order
+        self._rng = np.random.default_rng(random_seed)
+        self._random_seed = random_seed
+        self._max_queue_size = max_ventilation_queue_size or len(self._items) or 1
+        self._interval = ventilation_interval_s
+        self._epoch = start_epoch
+
+        self._in_flight = 0
+        self._in_flight_lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._completed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if not self._items:
+            self._completed.set()
+
+    @property
+    def epoch(self) -> int:
+        """Epochs fully ventilated so far (checkpointable progress marker)."""
+        return self._epoch
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError('Ventilator already started')
+        self._thread = threading.Thread(target=self._ventilate_loop, daemon=True,
+                                        name='petastorm-tpu-ventilator')
+        self._thread.start()
+
+    def _ventilate_loop(self):
+        while not self._stop_event.is_set():
+            if self._iterations_remaining is not None and self._iterations_remaining <= 0:
+                break
+            order = self._items
+            if self._randomize_item_order:
+                # Seeded per-epoch shuffle: epoch k order is reproducible from
+                # (seed, k) which makes mid-training restarts deterministic.
+                order = list(self._items)
+                self._rng.shuffle(order)
+            for item in order:
+                while not self._stop_event.is_set():
+                    with self._in_flight_lock:
+                        if self._in_flight < self._max_queue_size:
+                            self._in_flight += 1
+                            break
+                    time.sleep(self._interval)
+                if self._stop_event.is_set():
+                    return
+                self._ventilate_fn(**item) if isinstance(item, dict) else self._ventilate_fn(item)
+            self._epoch += 1
+            if self._iterations_remaining is not None:
+                self._iterations_remaining -= 1
+        self._completed.set()
+
+    def processed_item(self):
+        with self._in_flight_lock:
+            self._in_flight -= 1
+
+    def completed(self) -> bool:
+        # All epochs ventilated AND nothing still in flight.
+        if not self._completed.is_set():
+            return False
+        with self._in_flight_lock:
+            return self._in_flight == 0
+
+    def fully_ventilated(self) -> bool:
+        """True once all epochs were handed to the pool (items may still be in flight)."""
+        return self._completed.is_set()
+
+    def reset(self, iterations: Optional[int] = 1):
+        """Restart ventilation for more epochs; only legal after completion
+        (reference ``ventilator.py:125-134``)."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError('Cannot reset a ventilator that has not completed')
+        self._iterations_remaining = iterations
+        self._stop_event.clear()
+        self._completed.clear()
+        if not self._items:
+            self._completed.set()
+        self._thread = None
+        self.start()
+
+    def stop(self):
+        self._stop_event.set()
+        self._completed.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,21 @@
+"""Worker protocol (reference ``petastorm/workers_pool/worker_base.py:18-35``)."""
+
+from abc import ABC, abstractmethod
+
+
+class WorkerBase(ABC):
+    """A worker processes ventilated items and emits 0..n results via
+    ``publish_func``. One instance lives per thread/process."""
+
+    def __init__(self, worker_id, publish_func, args):
+        self.worker_id = worker_id
+        self.publish_func = publish_func
+        self.args = args
+
+    @abstractmethod
+    def process(self, *args, **kwargs):
+        """Process one ventilated work item; call ``self.publish_func(result)``
+        zero or more times."""
+
+    def shutdown(self):
+        """Optional cleanup hook invoked when the pool stops."""

@@ -25,7 +25,8 @@ def test_materialize_writes_common_metadata(synthetic_dataset):
     assert UNISCHEMA_KEY in meta
     assert ROW_GROUPS_PER_FILE_KEY in meta
     counts = json.loads(meta[ROW_GROUPS_PER_FILE_KEY].decode())
-    assert sum(counts.values()) >= 4  # multiple files, at least one rg each
+    assert sum(len(v) for v in counts.values()) >= 4  # multiple files, >=1 rg each
+    assert sum(sum(v) for v in counts.values()) == 100  # per-group row counts stored
 
 
 def test_get_schema_roundtrip(synthetic_dataset):
@@ -51,12 +52,27 @@ def test_load_row_groups_from_metadata(synthetic_dataset):
     assert len(pieces) >= 4
     # deterministic sorted order
     assert pieces == sorted(pieces, key=lambda p: (p.path, p.row_group))
-    # sum of piece rows equals dataset size
+    # piece num_rows populated from metadata and consistent with actual footers
     total = 0
     for piece in pieces:
         pf = pq.ParquetFile(piece.path)
-        total += pf.metadata.row_group(piece.row_group).num_rows
+        actual = pf.metadata.row_group(piece.row_group).num_rows
+        assert piece.num_rows == actual
+        total += actual
     assert total == len(synthetic_dataset.data)
+
+
+def test_generate_metadata_on_foreign_store(tmp_path):
+    from petastorm_tpu.etl.generate_metadata import generate_metadata
+    from petastorm_tpu.test_util.dataset_gen import create_non_petastorm_dataset
+    url = 'file://' + str(tmp_path / 'foreign')
+    create_non_petastorm_dataset(url, 40)
+    generate_metadata(url)
+    schema = get_schema_from_dataset_url(url)
+    assert 'id' in schema.fields
+    fs, path, _ = get_filesystem_and_path_or_paths(url)
+    pieces = load_row_groups(fs, path)
+    assert sum(p.num_rows for p in pieces) == 40
 
 
 def test_load_row_groups_footer_fallback(non_petastorm_dataset):

@@ -1,0 +1,169 @@
+"""Worker-pool matrix tests (reference ``tests/test_workers_pool.py``,
+``tests/test_ventilator.py``)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.test_util.pool_workers import (ArrayWorker, FailingWorker, MultiEmitWorker,
+                                                  SquareWorker)
+from petastorm_tpu.workers import EmptyResultError, TimeoutWaitingForResultError
+from petastorm_tpu.workers.dummy_pool import DummyPool
+from petastorm_tpu.workers.process_pool import ProcessPool
+from petastorm_tpu.workers.serializers import ArrowTableSerializer, PickleSerializer
+from petastorm_tpu.workers.thread_pool import ThreadPool
+from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+
+ALL_POOLS = [lambda: DummyPool(), lambda: ThreadPool(4), lambda: ProcessPool(2)]
+POOL_IDS = ['dummy', 'thread', 'process']
+
+
+def drain(pool):
+    results = []
+    while True:
+        try:
+            results.append(pool.get_results(timeout=30))
+        except EmptyResultError:
+            return results
+
+
+@pytest.mark.parametrize('pool_factory', ALL_POOLS, ids=POOL_IDS)
+def test_square_with_ventilator(pool_factory):
+    pool = pool_factory()
+    items = [{'x': i} for i in range(20)]
+    vent = ConcurrentVentilator(pool.ventilate, items, iterations=1)
+    pool.start(SquareWorker, ventilator=vent)
+    results = drain(pool)
+    assert sorted(results) == sorted(i * i for i in range(20))
+    pool.stop()
+    pool.join()
+
+
+@pytest.mark.parametrize('pool_factory', ALL_POOLS, ids=POOL_IDS)
+def test_manual_ventilation(pool_factory):
+    pool = pool_factory()
+    pool.start(SquareWorker)
+    for i in range(5):
+        pool.ventilate(i)
+    assert sorted(drain(pool)) == [0, 1, 4, 9, 16]
+    pool.stop()
+    pool.join()
+
+
+@pytest.mark.parametrize('pool_factory', ALL_POOLS, ids=POOL_IDS)
+def test_multiple_epochs(pool_factory):
+    pool = pool_factory()
+    items = [{'x': i} for i in range(5)]
+    vent = ConcurrentVentilator(pool.ventilate, items, iterations=3)
+    pool.start(SquareWorker, ventilator=vent)
+    results = drain(pool)
+    assert len(results) == 15
+    pool.stop()
+    pool.join()
+
+
+@pytest.mark.parametrize('pool_factory', ALL_POOLS, ids=POOL_IDS)
+def test_zero_or_many_results_per_item(pool_factory):
+    pool = pool_factory()
+    vent = ConcurrentVentilator(pool.ventilate,
+                                [{'x': 1, 'count': 0}, {'x': 2, 'count': 3}], iterations=1)
+    pool.start(MultiEmitWorker, ventilator=vent)
+    assert sorted(drain(pool)) == [2, 2, 2]
+    pool.stop()
+    pool.join()
+
+
+@pytest.mark.parametrize('pool_factory', [lambda: DummyPool(), lambda: ThreadPool(2),
+                                          lambda: ProcessPool(1)], ids=POOL_IDS)
+def test_worker_exception_propagates(pool_factory):
+    pool = pool_factory()
+    pool.start(FailingWorker, worker_args={'poison': 3})
+    for i in range(5):
+        pool.ventilate(i)
+    with pytest.raises(ValueError, match='poisoned item 3'):
+        drain(pool)
+    pool.stop()
+    pool.join()
+
+
+def test_process_pool_array_payloads():
+    pool = ProcessPool(2)
+    vent = ConcurrentVentilator(pool.ventilate, [{'n': i} for i in range(1, 8)], iterations=1)
+    pool.start(ArrayWorker, ventilator=vent)
+    results = drain(pool)
+    assert sorted(len(r) for r in results) == list(range(1, 8))
+    for r in results:
+        np.testing.assert_array_equal(r, np.full((len(r),), len(r)))
+    pool.stop()
+    pool.join()
+
+
+def test_thread_pool_timeout():
+    pool = ThreadPool(1)
+    vent = ConcurrentVentilator(pool.ventilate, [{'x': 1}], iterations=None)  # infinite
+    pool.start(SquareWorker, ventilator=vent)
+    assert pool.get_results(timeout=10) == 1
+    pool.stop()
+    pool.join()
+
+
+def test_ventilator_backpressure_bound():
+    ventilated = []
+    vent = ConcurrentVentilator(lambda **kw: ventilated.append(kw), [{'x': i} for i in range(100)],
+                                iterations=1, max_ventilation_queue_size=10)
+    vent.start()
+    import time
+    time.sleep(0.3)
+    assert len(ventilated) == 10  # blocked until items are marked processed
+    for _ in range(90):
+        vent.processed_item()
+    time.sleep(0.5)
+    assert len(ventilated) == 100
+    vent.stop()
+
+
+def test_ventilator_seeded_shuffle_is_reproducible():
+    orders = []
+    for _ in range(2):
+        seen = []
+        vent = ConcurrentVentilator(lambda **kw: seen.append(kw['x']),
+                                    [{'x': i} for i in range(50)], iterations=1,
+                                    randomize_item_order=True, random_seed=123)
+        vent.start()
+        while not vent.fully_ventilated():
+            for _ in range(len(seen)):
+                pass
+            import time
+            time.sleep(0.01)
+        # mark all processed so completed() is reachable
+        for _ in range(len(seen)):
+            vent.processed_item()
+        orders.append(seen)
+        vent.stop()
+    assert orders[0] == orders[1]
+    assert orders[0] != list(range(50))  # actually shuffled
+
+
+def test_ventilator_reset_after_completion():
+    pool = ThreadPool(2)
+    vent = ConcurrentVentilator(pool.ventilate, [{'x': i} for i in range(5)], iterations=1)
+    pool.start(SquareWorker, ventilator=vent)
+    assert len(drain(pool)) == 5
+    vent.reset(iterations=1)
+    assert len(drain(pool)) == 5
+    pool.stop()
+    pool.join()
+
+
+def test_diagnostics_surface():
+    pool = ThreadPool(2)
+    pool.start(SquareWorker)
+    assert 'output_queue_size' in pool.diagnostics
+    pool.stop()
+    pool.join()
+
+    pool = ProcessPool(1)
+    pool.start(SquareWorker)
+    d = pool.diagnostics
+    assert {'items_consumed', 'items_produced', 'items_inprocess'} <= set(d)
+    pool.stop()
+    pool.join()
