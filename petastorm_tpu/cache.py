@@ -1,0 +1,131 @@
+"""Row-group caches.
+
+Reference parity: ``petastorm/cache.py:20-39`` (``CacheBase``/``NullCache``),
+``local_disk_cache.py:22-63`` (``LocalDiskCache``). The reference delegates to
+the ``diskcache`` package; this is a self-contained file-based implementation
+with approximate-LRU size-bounded eviction and atomic writes, safe for
+concurrent worker threads/processes on one host.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import tempfile
+from abc import ABC, abstractmethod
+
+logger = logging.getLogger(__name__)
+
+
+class CacheBase(ABC):
+    @abstractmethod
+    def get(self, key: str, fill_cache_func):
+        """Return the cached value for ``key``; on miss call ``fill_cache_func()``,
+        store and return its result."""
+
+    def cleanup(self):
+        """Remove on-disk state, if any."""
+
+
+class NullCache(CacheBase):
+    """Pass-through cache: always calls the fill function."""
+
+    def get(self, key, fill_cache_func):
+        return fill_cache_func()
+
+
+class LocalDiskCache(CacheBase):
+    """Pickle-on-disk cache with a size limit and mtime-LRU eviction.
+
+    :param path: cache directory (created if missing).
+    :param size_limit_bytes: approximate cap on total cached bytes.
+    :param expected_row_size_bytes: advisory, kept for reference API parity.
+    :param shards: fan-out subdirectories to keep directory listings short
+        (reference shard sanity check, ``local_disk_cache.py:46-51``).
+    """
+
+    def __init__(self, path: str, size_limit_bytes: int,
+                 expected_row_size_bytes: int = 0, shards: int = 6, cleanup: bool = False):
+        self._path = path
+        self._size_limit = size_limit_bytes
+        self._shards = shards
+        self._cleanup_on_exit = cleanup
+        for shard in range(shards):
+            os.makedirs(os.path.join(path, 'shard_{:02d}'.format(shard)), exist_ok=True)
+
+    def _key_path(self, key: str) -> str:
+        digest = hashlib.md5(key.encode('utf-8')).hexdigest()
+        shard = int(digest[:4], 16) % self._shards
+        return os.path.join(self._path, 'shard_{:02d}'.format(shard), digest + '.pkl')
+
+    def get(self, key, fill_cache_func):
+        path = self._key_path(key)
+        try:
+            with open(path, 'rb') as f:
+                value = pickle.load(f)
+            # touch for LRU ordering
+            os.utime(path, None)
+            return value
+        except (OSError, pickle.UnpicklingError, EOFError):
+            pass
+        value = fill_cache_func()
+        try:
+            self._store(path, value)
+        except OSError as e:  # cache failures must never fail the read path
+            logger.warning('Failed to store cache entry: %s', e)
+        return value
+
+    def _store(self, path: str, value) -> None:
+        payload = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        self._evict_if_needed(len(payload))
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        try:
+            with os.fdopen(fd, 'wb') as f:
+                f.write(payload)
+            os.replace(tmp, path)  # atomic on POSIX
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _entries(self):
+        for shard in range(self._shards):
+            shard_dir = os.path.join(self._path, 'shard_{:02d}'.format(shard))
+            try:
+                names = os.listdir(shard_dir)
+            except OSError:
+                continue
+            for name in names:
+                full = os.path.join(shard_dir, name)
+                try:
+                    st = os.stat(full)
+                except OSError:
+                    continue
+                yield full, st.st_size, st.st_mtime
+
+    def _evict_if_needed(self, incoming_bytes: int) -> None:
+        entries = list(self._entries())
+        total = sum(size for _, size, _ in entries) + incoming_bytes
+        if total <= self._size_limit:
+            return
+        for full, size, _ in sorted(entries, key=lambda e: e[2]):  # oldest first
+            try:
+                os.remove(full)
+                total -= size
+            except OSError:
+                pass
+            if total <= self._size_limit:
+                break
+
+    def size_bytes(self) -> int:
+        return sum(size for _, size, _ in self._entries())
+
+    def cleanup(self):
+        if not self._cleanup_on_exit:
+            return
+        import shutil
+        shutil.rmtree(self._path, ignore_errors=True)

@@ -200,8 +200,9 @@ def add_to_common_metadata(filesystem, dataset_path: str, key: bytes, value: byt
 
 @contextmanager
 def materialize_dataset(dataset_url: str, schema: Unischema,
-                        row_group_size_mb: int = _DEFAULT_ROW_GROUP_SIZE_MB,
+                        row_group_size_mb: float = _DEFAULT_ROW_GROUP_SIZE_MB,
                         rows_per_file: int = 100000,
+                        file_size_mb: float = 256,
                         compression: str = 'snappy',
                         overwrite: bool = False,
                         storage_options: Optional[Dict] = None):
@@ -235,7 +236,8 @@ def materialize_dataset(dataset_url: str, schema: Unischema,
         if fs.exists(meta_path):
             fs.rm(meta_path)
     writer = DatasetWriter(fs, path, schema, row_group_size_mb=row_group_size_mb,
-                           rows_per_file=rows_per_file, compression=compression)
+                           rows_per_file=rows_per_file, file_size_mb=file_size_mb,
+                           compression=compression)
     yield writer
     row_groups_per_file = writer.close()
     _write_common_metadata(fs, path, schema, row_groups_per_file)
@@ -276,9 +278,6 @@ def load_row_groups(filesystem, dataset_path: str,
             full = posixpath.join(dataset_path, relpath)
             parts = tuple(sorted(_partition_values_from_relpath(relpath).items()))
             per_group_rows = counts[relpath]
-            # Legacy int form (group count only) tolerated for robustness.
-            if isinstance(per_group_rows, int):
-                per_group_rows = [-1] * per_group_rows
             for rg, n in enumerate(per_group_rows):
                 pieces.append(RowGroupPiece(path=full, row_group=rg, num_rows=n,
                                             partition_values=parts))

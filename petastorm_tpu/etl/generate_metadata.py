@@ -16,8 +16,7 @@ from typing import Dict, Optional
 
 from petastorm_tpu.errors import PetastormMetadataError
 from petastorm_tpu.etl.dataset_metadata import (ROWGROUPS_INDEX_KEY, _list_data_files,
-                                                _partition_values_from_relpath,
-                                                _write_common_metadata, get_schema,
+                                                _write_common_metadata,
                                                 load_row_groups, read_common_metadata)
 from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dir_url
 from petastorm_tpu.unischema import Unischema
@@ -47,12 +46,11 @@ def generate_metadata(dataset_url: str, unischema: Optional[Unischema] = None,
     existing = read_common_metadata(fs, path) or {}
 
     if unischema is None:
-        try:
-            unischema = get_schema(fs, path)
-        except PetastormMetadataError:
-            from petastorm_tpu.etl.dataset_metadata import read_dataset_arrow_schema
-            arrow_schema = read_dataset_arrow_schema(fs, path)
-            unischema = Unischema.from_arrow_schema(arrow_schema)
+        # infer_or_load_unischema handles both the stored-schema case and
+        # inference (incl. hive partition columns) for foreign stores.
+        from petastorm_tpu.etl.dataset_metadata import infer_or_load_unischema
+        unischema, was_stored = infer_or_load_unischema(fs, path)
+        if not was_stored:
             logger.info('No stored unischema; inferred one from the arrow schema')
 
     # Footer scan (concurrent) for accurate per-row-group row counts.

@@ -1,0 +1,72 @@
+"""Build and load secondary row-group indexes.
+
+Reference parity: ``petastorm/etl/rowgroup_indexing.py`` —
+``build_rowgroup_index`` (:37-80) and ``get_row_group_indexes`` (:136-158).
+The reference distributes index building over a Spark job; here a host thread
+pool scans the row groups (pyarrow reads release the GIL), and the result is
+JSON in ``_common_metadata`` instead of a pickle.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import pyarrow.parquet as pq
+
+from petastorm_tpu.errors import PetastormMetadataError
+from petastorm_tpu.etl.dataset_metadata import (ROWGROUPS_INDEX_KEY, add_to_common_metadata,
+                                                get_schema, load_row_groups,
+                                                read_common_metadata)
+from petastorm_tpu.etl.rowgroup_indexers import RowGroupIndexerBase
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dir_url
+from petastorm_tpu.unischema import decode_row
+
+logger = logging.getLogger(__name__)
+
+
+def build_rowgroup_index(dataset_url: str, indexers: List[RowGroupIndexerBase],
+                         storage_options: Optional[Dict] = None,
+                         num_workers: int = 8) -> None:
+    """Scan every row group, feed the indexers, and persist the combined index
+    into ``_common_metadata`` under ``ROWGROUPS_INDEX_KEY``."""
+    dataset_url = normalize_dir_url(dataset_url)
+    fs, path, _ = get_filesystem_and_path_or_paths(dataset_url, storage_options)
+    schema = get_schema(fs, path)
+    pieces = load_row_groups(fs, path)
+    if not pieces:
+        raise PetastormMetadataError('No row groups found at {}'.format(dataset_url))
+
+    columns = sorted({c for indexer in indexers for c in indexer.column_names})
+    unknown = set(columns) - set(schema.fields.keys())
+    if unknown:
+        raise ValueError('Indexed fields not in schema: {}'.format(sorted(unknown)))
+
+    def scan(piece_with_index):
+        piece_index, piece = piece_with_index
+        with fs.open(piece.path, 'rb') as f:
+            table = pq.ParquetFile(f).read_row_group(piece.row_group, columns=columns)
+        rows = [decode_row(r, schema) for r in table.to_pylist()]
+        return piece_index, rows
+
+    with ThreadPoolExecutor(max_workers=num_workers) as executor:
+        for piece_index, rows in executor.map(scan, enumerate(pieces)):
+            for indexer in indexers:
+                indexer.build_index(rows, piece_index)
+
+    payload = json.dumps({ix.index_name: ix.to_json_dict() for ix in indexers})
+    add_to_common_metadata(fs, path, ROWGROUPS_INDEX_KEY, payload.encode('utf-8'))
+    logger.info('Built %d indexes over %d row groups', len(indexers), len(pieces))
+
+
+def get_row_group_indexes(filesystem, dataset_path: str) -> Dict[str, RowGroupIndexerBase]:
+    """Load the stored indexes, keyed by index name."""
+    metadata = read_common_metadata(filesystem, dataset_path)
+    if not metadata or ROWGROUPS_INDEX_KEY not in metadata:
+        raise PetastormMetadataError(
+            'Dataset at {} has no row-group index. Build one with '
+            'petastorm_tpu.etl.rowgroup_indexing.build_rowgroup_index'.format(dataset_path))
+    raw = json.loads(metadata[ROWGROUPS_INDEX_KEY].decode('utf-8'))
+    return {name: RowGroupIndexerBase.from_json_dict(d) for name, d in raw.items()}

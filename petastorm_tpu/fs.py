@@ -78,8 +78,9 @@ def _parse_url(url: str) -> Tuple[str, str]:
             scheme, url, sorted(s for s in _SCHEME_ALIASES if s)))
     protocol = _SCHEME_ALIASES[scheme]
     if protocol == 'file':
-        if parsed.netloc:
-            # 'file://tmp/x' would silently resolve to '/x'; catch the common typo.
+        # RFC 8089 allows 'file://localhost/abs/path'; any other authority means
+        # the user typed 'file://tmp/x' expecting /tmp/x — catch that typo.
+        if parsed.netloc and parsed.netloc != 'localhost':
             raise ValueError(
                 'file:// URLs must use three slashes (file:///abs/path); got {!r} whose '
                 'authority component {!r} would be dropped'.format(url, parsed.netloc))

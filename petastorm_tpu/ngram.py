@@ -1,0 +1,133 @@
+"""NGram: windowed sequence assembly over timestamp-sorted rows.
+
+Reference parity: ``petastorm/ngram.py`` — constructor semantics (:102-125),
+``form_ngram`` window scan (:225-270), ``_ngram_pass_threshold`` (:179-193),
+per-timestep schema views (:215-223), regex field resolution (:195-203).
+Sequences never cross row-group boundaries (doc :85-91) — for the TPU build
+this is the input pipeline for transformer-LM token windows (BASELINE.json
+config #5), so window length is bounded by row-group size by design.
+
+An n-gram is a dict ``{offset: row}`` where offsets are the keys of ``fields``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from petastorm_tpu.unischema import Unischema, UnischemaField, match_unischema_fields
+
+
+class NGram:
+    """Defines a sliding window over consecutive rows.
+
+    :param fields: ``{offset: [UnischemaField | regex string, ...]}`` — which
+        fields are produced at each timestep. Offsets must be consecutive
+        integers (any start).
+    :param delta_threshold: maximum allowed timestamp delta between two
+        consecutive rows of a window; larger gaps reject the window.
+    :param timestamp_field: the :class:`UnischemaField` (or name) ordering rows.
+    :param timestamp_overlap: if False, emitted windows must not overlap in
+        timestamp ranges (reference ``ngram.py:117-125``).
+    """
+
+    def __init__(self, fields: Dict[int, List], delta_threshold,
+                 timestamp_field: Union[UnischemaField, str],
+                 timestamp_overlap: bool = True):
+        offsets = sorted(fields.keys())
+        if not offsets:
+            raise ValueError('NGram fields must have at least one timestep')
+        if offsets != list(range(offsets[0], offsets[0] + len(offsets))):
+            raise ValueError('NGram offsets must be consecutive integers, got {}'.format(offsets))
+        self._fields = {k: list(v) for k, v in fields.items()}
+        self._delta_threshold = delta_threshold
+        self._timestamp_field = timestamp_field
+        self._timestamp_overlap = timestamp_overlap
+
+    @property
+    def fields(self) -> Dict[int, List]:
+        return self._fields
+
+    @property
+    def delta_threshold(self):
+        return self._delta_threshold
+
+    @property
+    def length(self) -> int:
+        return len(self._fields)
+
+    @property
+    def timestamp_field_name(self) -> str:
+        if isinstance(self._timestamp_field, UnischemaField):
+            return self._timestamp_field.name
+        return self._timestamp_field
+
+    @property
+    def timestamp_overlap(self) -> bool:
+        return self._timestamp_overlap
+
+    def resolve_regex_field_names(self, schema: Unischema) -> None:
+        """Replace regex strings in ``fields`` with matching schema fields
+        (reference ``ngram.py:195-203``)."""
+        for offset, field_list in self._fields.items():
+            resolved = []
+            for f in field_list:
+                if isinstance(f, str):
+                    matched = match_unischema_fields(schema, [f])
+                    if not matched:
+                        raise ValueError('NGram regex {!r} matched no fields'.format(f))
+                    resolved.extend(matched)
+                else:
+                    resolved.append(f)
+            # dedupe preserving order
+            seen = set()
+            self._fields[offset] = [f for f in resolved
+                                    if not (f.name in seen or seen.add(f.name))]
+
+    def get_field_names_at_timestep(self, timestep: int) -> List[str]:
+        return [f.name for f in self._fields.get(timestep, [])]
+
+    def get_schema_at_timestep(self, schema: Unischema, timestep: int) -> Unischema:
+        """Schema view holding only this timestep's fields
+        (reference ``ngram.py:215-223``)."""
+        return schema.create_schema_view(
+            [f for f in self._fields.get(timestep, []) if f.name in schema.fields])
+
+    def get_all_field_names(self) -> List[str]:
+        """Union of all timesteps' fields plus the timestamp field — the columns
+        a worker must read."""
+        names = {self.timestamp_field_name}
+        for field_list in self._fields.values():
+            names.update(f.name if isinstance(f, UnischemaField) else f
+                         for f in field_list)
+        return sorted(names)
+
+    def _window_passes_threshold(self, window: List[dict]) -> bool:
+        ts_name = self.timestamp_field_name
+        for previous, current in zip(window, window[1:]):
+            if current[ts_name] - previous[ts_name] > self._delta_threshold:
+                return False
+        return True
+
+    def form_ngram(self, data: List[dict], schema: Unischema) -> List[Dict[int, object]]:
+        """Scan timestamp-sorted rows and emit all valid windows as
+        ``{offset: namedtuple}`` dicts (reference ``ngram.py:225-270``)."""
+        ts_name = self.timestamp_field_name
+        rows = sorted(data, key=lambda r: r[ts_name])
+        offsets = sorted(self._fields.keys())
+        ngrams = []
+        previous_window_end_ts = None
+        for start in range(len(rows) - self.length + 1):
+            window = rows[start:start + self.length]
+            if not self._window_passes_threshold(window):
+                continue
+            if (not self._timestamp_overlap and previous_window_end_ts is not None
+                    and window[0][ts_name] <= previous_window_end_ts):
+                continue
+            ngram = {}
+            for offset, row in zip(offsets, window):
+                view = self.get_schema_at_timestep(schema, offset)
+                ngram[offset] = view.make_namedtuple(
+                    **{name: row[name] for name in view.fields})
+            ngrams.append(ngram)
+            previous_window_end_ts = window[-1][ts_name]
+        return ngrams

@@ -1,0 +1,423 @@
+"""Reader core: ``make_reader`` / ``make_batch_reader`` factories and the
+``Reader`` orchestrator.
+
+Reference parity: ``petastorm/reader.py`` — ``make_reader`` (:61-195),
+``make_batch_reader`` (:198-327), ``Reader`` (:330-676): constructor pipeline
+(:384-462), row-group filtering by predicate/selector/shard (:498-608),
+ventilation (:622-637), iterator protocol (:655-665), ``reset`` (:468-492),
+context manager (:670-676), diagnostics (:648-650).
+
+TPU-first deviations:
+ - ``seed`` gives a reproducible epoch shuffle (ventilator is seeded).
+ - ``cur_shard``/``shard_count`` default to the JAX process if
+   ``shard_by_jax_process=True`` is passed (multi-host pods read disjoint
+   row-group shards; see SURVEY.md §2 "Parallelism accounting").
+ - The reader never touches the TPU: it produces numpy/namedtuple rows.
+   Device staging lives in :mod:`petastorm_tpu.jaxio`.
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+from typing import List, Optional
+
+from petastorm_tpu.cache import LocalDiskCache, NullCache
+from petastorm_tpu.errors import NoDataAvailableError, PetastormMetadataError
+from petastorm_tpu.etl.dataset_metadata import (get_schema, infer_or_load_unischema,
+                                                load_row_groups)
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dataset_url_or_urls
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.readers.batch_worker import ArrowBatchWorker, BatchResultsReader
+from petastorm_tpu.readers.row_worker import RowGroupResultsReader, RowGroupWorker
+from petastorm_tpu.transform import transform_schema
+from petastorm_tpu.unischema import match_unischema_fields
+from petastorm_tpu.workers import EmptyResultError
+from petastorm_tpu.workers.dummy_pool import DummyPool
+from petastorm_tpu.workers.process_pool import ProcessPool
+from petastorm_tpu.workers.serializers import ArrowTableSerializer, PickleSerializer
+from petastorm_tpu.workers.thread_pool import ThreadPool
+from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+
+logger = logging.getLogger(__name__)
+
+#: Extra row groups ventilated beyond the worker count, keeping workers busy
+#: without unbounded decode-ahead (reference ``reader.py:46``).
+_VENTILATE_EXTRA_ROWGROUPS = 2
+
+
+def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
+                cache_extra_settings):
+    if cache_type in (None, 'null'):
+        return NullCache()
+    if cache_type == 'local-disk':
+        if not cache_location or not cache_size_limit:
+            raise ValueError("cache_type='local-disk' needs cache_location and "
+                             'cache_size_limit')
+        return LocalDiskCache(cache_location, cache_size_limit,
+                              cache_row_size_estimate or 0,
+                              **(cache_extra_settings or {}))
+    raise ValueError('Unknown cache_type {!r}'.format(cache_type))
+
+
+def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer,
+               zmq_copy_buffers, profiling_enabled=False):
+    if reader_pool_type == 'thread':
+        return ThreadPool(workers_count, results_queue_size,
+                          profiling_enabled=profiling_enabled)
+    if reader_pool_type == 'process':
+        return ProcessPool(workers_count, serializer=serializer,
+                           zmq_copy_buffers=zmq_copy_buffers)
+    if reader_pool_type == 'dummy':
+        return DummyPool()
+    raise ValueError("reader_pool_type must be one of 'thread', 'process', 'dummy'; "
+                     'got {!r}'.format(reader_pool_type))
+
+
+def _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process):
+    if not shard_by_jax_process:
+        return cur_shard, shard_count
+    if cur_shard is not None or shard_count is not None:
+        raise ValueError('shard_by_jax_process is mutually exclusive with explicit '
+                         'cur_shard/shard_count')
+    import jax
+    return jax.process_index(), jax.process_count()
+
+
+def make_reader(dataset_url,
+                schema_fields=None,
+                reader_pool_type='thread', workers_count=10, results_queue_size=50,
+                seed=None, shuffle_row_groups=True, shuffle_row_drop_partitions=1,
+                predicate=None, rowgroup_selector=None,
+                num_epochs=1,
+                cur_shard=None, shard_count=None, shard_by_jax_process=False,
+                cache_type='null', cache_location=None, cache_size_limit=None,
+                cache_row_size_estimate=None, cache_extra_settings=None,
+                transform_spec=None, filters=None,
+                storage_options=None, zmq_copy_buffers=True,
+                profiling_enabled=False):
+    """Row-granular reader for petastorm_tpu datasets (codec-decoded rows).
+
+    Mirrors the reference factory (``reader.py:61-195``). Raises a helpful error
+    directing to :func:`make_batch_reader` when the store lacks petastorm
+    metadata (reference behavior at ``reader.py:128-141``).
+    """
+    dataset_url = normalize_dataset_url_or_urls(dataset_url)
+    fs, path, factory = get_filesystem_and_path_or_paths(dataset_url, storage_options)
+    if isinstance(path, list):
+        raise ValueError('make_reader supports a single dataset url; a list of file '
+                         'urls is only supported by make_batch_reader')
+    try:
+        get_schema(fs, path)
+    except PetastormMetadataError as e:
+        raise RuntimeError(
+            'Dataset at {} is missing petastorm_tpu metadata ({}). If this is a plain '
+            'parquet store, use make_batch_reader instead.'.format(dataset_url, e))
+
+    cache = _make_cache(cache_type, cache_location, cache_size_limit,
+                        cache_row_size_estimate, cache_extra_settings)
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
+                      PickleSerializer(), zmq_copy_buffers, profiling_enabled)
+    cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
+    return Reader(factory, path,
+                  worker_class=RowGroupWorker,
+                  results_reader_factory=RowGroupResultsReader,
+                  schema_fields=schema_fields, seed=seed,
+                  shuffle_row_groups=shuffle_row_groups,
+                  shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                  predicate=predicate, rowgroup_selector=rowgroup_selector,
+                  num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
+                  cache=cache, transform_spec=transform_spec, filters=filters,
+                  pool=pool, is_batched_reader=False)
+
+
+def make_batch_reader(dataset_url_or_urls,
+                      schema_fields=None,
+                      reader_pool_type='thread', workers_count=10, results_queue_size=50,
+                      seed=None, shuffle_row_groups=True,
+                      predicate=None,
+                      num_epochs=1,
+                      cur_shard=None, shard_count=None, shard_by_jax_process=False,
+                      cache_type='null', cache_location=None, cache_size_limit=None,
+                      cache_row_size_estimate=None, cache_extra_settings=None,
+                      transform_spec=None, filters=None,
+                      storage_options=None, zmq_copy_buffers=True,
+                      profiling_enabled=False):
+    """Vectorized batch reader for arbitrary parquet stores
+    (reference ``reader.py:198-327``). Yields namedtuples of column arrays,
+    one per row group."""
+    dataset_url_or_urls = normalize_dataset_url_or_urls(dataset_url_or_urls)
+    fs, path, factory = get_filesystem_and_path_or_paths(dataset_url_or_urls,
+                                                         storage_options)
+    if schema_fields is not None and not (
+            isinstance(schema_fields, list)
+            and all(isinstance(f, str) for f in schema_fields)):
+        raise ValueError('make_batch_reader schema_fields must be a list of regex '
+                         'strings (UnischemaField selection and NGram are row-reader '
+                         'features)')
+    cache = _make_cache(cache_type, cache_location, cache_size_limit,
+                        cache_row_size_estimate, cache_extra_settings)
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
+                      ArrowTableSerializer(), zmq_copy_buffers, profiling_enabled)
+    cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
+    return Reader(factory, path,
+                  worker_class=ArrowBatchWorker,
+                  results_reader_factory=BatchResultsReader,
+                  schema_fields=schema_fields, seed=seed,
+                  shuffle_row_groups=shuffle_row_groups, shuffle_row_drop_partitions=1,
+                  predicate=predicate, rowgroup_selector=None,
+                  num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
+                  cache=cache, transform_spec=transform_spec, filters=filters,
+                  pool=pool, is_batched_reader=True)
+
+
+class Reader:
+    """Iterates rows (or batches) of a parquet dataset through a worker pool."""
+
+    def __init__(self, filesystem_factory, dataset_path,
+                 worker_class, results_reader_factory,
+                 schema_fields=None, seed=None, shuffle_row_groups=True,
+                 shuffle_row_drop_partitions=1, predicate=None, rowgroup_selector=None,
+                 num_epochs=1, cur_shard=None, shard_count=None,
+                 cache=None, transform_spec=None, filters=None,
+                 pool=None, is_batched_reader=False):
+        if (cur_shard is None) != (shard_count is None):
+            raise ValueError('cur_shard and shard_count must be specified together')
+        if cur_shard is not None and not 0 <= cur_shard < shard_count:
+            raise ValueError('cur_shard {} out of range for shard_count {}'.format(
+                cur_shard, shard_count))
+        if predicate is not None and not isinstance(cache, NullCache):
+            raise RuntimeError('Local cache is not supported together with predicates '
+                               '(cached row groups would bypass predicate evaluation)')
+        self._filesystem_factory = filesystem_factory
+        self._dataset_path = dataset_path
+        self._pool = pool
+        self._is_batched_reader = is_batched_reader
+        self._num_epochs = num_epochs
+        self.last_row_consumed = False
+
+        filesystem = filesystem_factory()
+        stored_schema, _ = infer_or_load_unischema(filesystem, dataset_path)
+
+        # -- schema view / ngram resolution (reference reader.py:408-441) ------
+        self.ngram = schema_fields if isinstance(schema_fields, NGram) else None
+        if self.ngram is not None:
+            if is_batched_reader:
+                raise ValueError('NGram is not supported by make_batch_reader')
+            if not self.ngram.timestamp_overlap and shuffle_row_drop_partitions > 1:
+                raise NotImplementedError(
+                    'shuffle_row_drop_partitions is not supported with '
+                    'timestamp_overlap=False (reference reader.py:420-422)')
+            self.ngram.resolve_regex_field_names(stored_schema)
+            ngram_field_names = self.ngram.get_all_field_names()
+            view_fields = [stored_schema.fields[n] for n in ngram_field_names
+                           if n in stored_schema.fields]
+            view_schema = stored_schema.create_schema_view(view_fields)
+        elif schema_fields is not None:
+            if isinstance(schema_fields, list) and all(isinstance(f, str)
+                                                       for f in schema_fields):
+                matched = match_unischema_fields(stored_schema, schema_fields)
+                if not matched:
+                    raise ValueError('schema_fields {} matched no fields'.format(
+                        schema_fields))
+                view_schema = stored_schema.create_schema_view(matched)
+            else:
+                view_schema = stored_schema.create_schema_view(schema_fields)
+        else:
+            view_schema = stored_schema
+
+        transformed_schema = (transform_schema(view_schema, transform_spec)
+                              if transform_spec is not None else view_schema)
+        #: The schema of the rows/batches this reader yields.
+        self.schema = transformed_schema
+
+        # -- row-group discovery + filtering (reference reader.py:498-608) -----
+        all_pieces = load_row_groups(filesystem, dataset_path)
+        if not all_pieces:
+            raise NoDataAvailableError('No row groups found at {}'.format(dataset_path))
+        pieces, worker_predicate = self._filter_row_groups(
+            filesystem, all_pieces, stored_schema, predicate, rowgroup_selector,
+            filters, cur_shard, shard_count)
+        if not pieces:
+            raise NoDataAvailableError(
+                'No row groups left after predicate/selector/shard filtering at '
+                '{}'.format(dataset_path))
+        self._pieces = pieces
+
+        # -- ventilation (reference reader.py:622-637) -------------------------
+        items = []
+        for piece_index in range(len(pieces)):
+            for drop_partition in range(shuffle_row_drop_partitions):
+                items.append({'piece_index': piece_index,
+                              'worker_predicate': worker_predicate,
+                              'shuffle_row_drop_partition': (
+                                  drop_partition, shuffle_row_drop_partitions)})
+        self._ventilator = ConcurrentVentilator(
+            pool.ventilate, items, iterations=num_epochs,
+            randomize_item_order=shuffle_row_groups, random_seed=seed,
+            max_ventilation_queue_size=pool.workers_count + _VENTILATE_EXTRA_ROWGROUPS)
+
+        worker_args = {
+            'filesystem_factory': filesystem_factory,
+            'dataset_path': dataset_path,
+            'schema': view_schema,
+            'full_schema': stored_schema,
+            'ngram': self.ngram,
+            'split_pieces': pieces,
+            'local_cache': cache,
+            'transform_spec': transform_spec,
+            'transformed_schema': transformed_schema,
+        }
+        pool.start(worker_class, worker_args, self._ventilator)
+        self._results_reader = results_reader_factory(transformed_schema, self.ngram)
+        self._stopped = False
+
+    @property
+    def batched_output(self) -> bool:
+        return self._is_batched_reader
+
+    # -- filtering -------------------------------------------------------------
+
+    def _filter_row_groups(self, filesystem, pieces, stored_schema, predicate,
+                           rowgroup_selector, filters, cur_shard, shard_count):
+        worker_predicate = None
+        if predicate is not None:
+            predicate_fields = set(predicate.get_fields())
+            unknown = predicate_fields - set(stored_schema.fields.keys())
+            if unknown:
+                raise ValueError('Predicate uses unknown fields: {}'.format(sorted(unknown)))
+            partition_keys = (set(pieces[0].partition_dict.keys()) if pieces else set())
+            if predicate_fields and predicate_fields <= partition_keys:
+                # Evaluate on partition values only: prune pieces with no reads
+                # (reference reader.py:577-608).
+                pieces = [p for p in pieces if predicate.do_include(
+                    {f: _cast_partition(stored_schema, f, p.partition_dict[f])
+                     for f in predicate_fields})]
+            else:
+                worker_predicate = predicate
+
+        if filters is not None:
+            pieces = [p for p in pieces if _piece_passes_filters(
+                p, filters, stored_schema)]
+
+        if rowgroup_selector is not None:
+            from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
+            indexes = get_row_group_indexes(filesystem, self._dataset_path)
+            missing = set(rowgroup_selector.get_index_names()) - set(indexes.keys())
+            if missing:
+                raise ValueError('Selector references unknown indexes: {}'.format(
+                    sorted(missing)))
+            selected = rowgroup_selector.select_row_groups(indexes)
+            pieces = [p for i, p in enumerate(pieces) if i in selected]
+
+        if cur_shard is not None:
+            if len(pieces) < shard_count:
+                warnings.warn(
+                    'Dataset has only {} row groups but {} shards were requested; '
+                    'some shards will receive no data'.format(len(pieces), shard_count))
+            pieces = [p for i, p in enumerate(pieces) if i % shard_count == cur_shard]
+        return pieces, worker_predicate
+
+    # -- iteration -------------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            row = self._results_reader.read_next(self._pool)
+            return row
+        except EmptyResultError:
+            self.last_row_consumed = True
+            raise StopIteration
+
+    def next(self):
+        return self.__next__()
+
+    def reset(self):
+        """Restart iteration for another ``num_epochs`` pass; only legal after
+        the previous pass fully drained (reference ``reader.py:468-492``)."""
+        if not self.last_row_consumed:
+            raise RuntimeError(
+                'Reader.reset() is only supported after the previous epoch set was '
+                'fully consumed (in-flight row groups cannot be recalled)')
+        self._ventilator.reset(self._num_epochs)
+        self.last_row_consumed = False
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def stop(self):
+        self._stopped = True
+        self._pool.stop()
+
+    def join(self):
+        self._pool.join()
+
+    def cleanup(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        self.join()
+
+    @property
+    def diagnostics(self):
+        return self._pool.diagnostics
+
+
+def _cast_partition(schema, field_name, value):
+    field = schema.fields.get(field_name)
+    if field is None or field.numpy_dtype is str:
+        return value
+    if field.numpy_dtype is bytes:
+        return value.encode('utf-8')
+    import numpy as np
+    return np.dtype(field.numpy_dtype).type(value)
+
+
+_FILTER_OPS = {
+    '=': lambda a, b: a == b,
+    '==': lambda a, b: a == b,
+    '!=': lambda a, b: a != b,
+    '<': lambda a, b: a < b,
+    '<=': lambda a, b: a <= b,
+    '>': lambda a, b: a > b,
+    '>=': lambda a, b: a >= b,
+    'in': lambda a, b: a in b,
+    'not in': lambda a, b: a not in b,
+}
+
+
+def _piece_passes_filters(piece, filters, schema) -> bool:
+    """pyarrow-style DNF filters evaluated on hive partition values
+    (reference passes ``filters`` into ``pq.ParquetDataset``, ``reader.py:399``).
+
+    ``filters`` is ``[(col, op, val), ...]`` (AND) or a list of such lists (OR).
+    """
+    if not filters:
+        return True
+    if isinstance(filters[0], tuple):
+        conjunctions = [filters]
+    else:
+        conjunctions = filters
+    values = piece.partition_dict
+    for conjunction in conjunctions:
+        ok = True
+        for col, op, val in conjunction:
+            if col not in values:
+                ok = False
+                break
+            actual = _cast_partition(schema, col, values[col])
+            # cast to the filter value's type when partition value is a string
+            if isinstance(actual, str) and not isinstance(val, str) \
+                    and not isinstance(val, (list, tuple, set)):
+                actual = type(val)(actual)
+            if not _FILTER_OPS[op](actual, val):
+                ok = False
+                break
+        if ok:
+            return True
+    return False

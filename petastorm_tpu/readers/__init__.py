@@ -1,0 +1,2 @@
+"""Reader workers: row-granular (decode to python rows) and batch-granular
+(arrow tables) — reference ``py_dict_reader_worker.py`` / ``arrow_reader_worker.py``."""

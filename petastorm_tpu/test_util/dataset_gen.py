@@ -89,6 +89,22 @@ def create_test_scalar_dataset(url: str, num_rows: int, num_files: int = 2,
     return rows
 
 
+def create_partitioned_dataset(url: str, num_rows: int, num_partitions: int = 3) -> List[Dict]:
+    """Hive-partitioned plain parquet store: ``part=p_K/part_*.parquet``."""
+    fs, path, _ = get_filesystem_and_path_or_paths(url)
+    rows = [{'id': i, 'value': float(i), 'part': 'p_{}'.format(i % num_partitions)}
+            for i in range(num_rows)]
+    for k in range(num_partitions):
+        part_dir = '{}/part=p_{}'.format(path, k)
+        fs.makedirs(part_dir, exist_ok=True)
+        chunk = [{'id': r['id'], 'value': r['value']} for r in rows
+                 if r['part'] == 'p_{}'.format(k)]
+        table = pa.Table.from_pylist(chunk)
+        with fs.open(part_dir + '/part_00000.parquet', 'wb') as f:
+            pq.write_table(table, f, row_group_size=max(1, len(chunk) // 2))
+    return rows
+
+
 def create_non_petastorm_dataset(url: str, num_rows: int, num_files: int = 2) -> List[Dict]:
     """A plain parquet store (no ``_common_metadata``) for ``make_batch_reader`` tests."""
     fs, path, _ = get_filesystem_and_path_or_paths(url)

@@ -155,17 +155,21 @@ class Unischema:
         typed = {}
         for key, value in kwargs.items():
             field = self._fields[key]
+            is_scalar_str = field.shape == () and (
+                field.numpy_dtype is str
+                or (not isinstance(field.numpy_dtype, type) and field.numpy_dtype.kind == 'U'))
             if value is None:
                 typed[key] = None
-            elif field.numpy_dtype is str or (not isinstance(field.numpy_dtype, type)
-                                              and field.numpy_dtype.kind == 'U'):
-                typed[key] = str(value) if not isinstance(value, str) else value
+            elif is_scalar_str and not isinstance(value, str):
+                typed[key] = str(value)
             else:
                 typed[key] = value
         return self._get_namedtuple()(**typed)
 
-    def make_namedtuple_tf(self, *args, **kwargs):  # pragma: no cover - compat alias
-        return self._get_namedtuple()(*args, **kwargs)
+    def make_batch_namedtuple(self, **column_arrays):
+        """Row-batch namedtuple: values are whole column arrays, no per-value
+        coercion (used by the batch reader path)."""
+        return self._get_namedtuple()(**column_arrays)
 
     # -- arrow schema / storage -------------------------------------------------
 

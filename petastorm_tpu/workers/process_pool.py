@@ -148,15 +148,14 @@ class ProcessPool:
         return True
 
     def get_results(self, timeout: Optional[float] = None):
-        waited = 0.0
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutWaitingForResultError(
+                    'No results after {:.1f}s'.format(timeout))
             if not dict(self._poller.poll(100)):
                 if self._all_work_consumed():
                     raise EmptyResultError()
-                waited += 0.1
-                if timeout is not None and waited >= timeout:
-                    raise TimeoutWaitingForResultError(
-                        'No results after {:.1f}s'.format(waited))
                 self._check_workers_alive()
                 continue
             payload, control = self._recv_multipart()

@@ -125,17 +125,17 @@ class ThreadPool:
         return True
 
     def get_results(self, timeout: Optional[float] = None):
-        waited = 0.0
+        import time
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutWaitingForResultError(
+                    'No results after {:.1f}s'.format(timeout))
             try:
                 item = self._results_queue.get(timeout=0.1)
             except queue.Empty:
                 if self._all_work_consumed() and self._results_queue.empty():
                     raise EmptyResultError()
-                waited += 0.1
-                if timeout is not None and waited >= timeout:
-                    raise TimeoutWaitingForResultError(
-                        'No results after {:.1f}s'.format(waited))
                 continue
             if isinstance(item, VentilatedItemProcessedMessage):
                 with self._accounting_lock:

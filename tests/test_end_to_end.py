@@ -1,0 +1,320 @@
+"""End-to-end reader tests over the pool-flavor matrix
+(reference ``tests/test_end_to_end.py``)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.errors import NoDataAvailableError
+from petastorm_tpu.predicates import in_lambda, in_pseudorandom_split, in_reduce, in_set
+from petastorm_tpu.test_util.dataset_gen import TestSchema
+from petastorm_tpu.transform import TransformSpec
+from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+# Reference parameterizes over reader factories x pool types
+# (test_end_to_end.py:42-58); process pool gets fewer workers to keep CI fast.
+POOLS = [('dummy', 1), ('thread', 4), ('process', 2)]
+POOL_IDS = [p[0] for p in POOLS]
+
+
+def _row_by_id(data, i):
+    return next(r for r in data if r['id'] == i)
+
+
+def _assert_rows_equal(actual_nt, expected: dict, fields=None):
+    for name in (fields or expected.keys()):
+        actual = getattr(actual_nt, name)
+        exp = expected[name]
+        if exp is None:
+            assert actual is None, name
+        elif isinstance(exp, np.ndarray):
+            np.testing.assert_array_equal(actual, exp, err_msg=name)
+        else:
+            assert actual == exp, name
+
+
+@pytest.mark.parametrize('pool_type,workers', POOLS, ids=POOL_IDS)
+def test_read_all_rows_value_exact(synthetic_dataset, pool_type, workers):
+    with make_reader(synthetic_dataset.url, reader_pool_type=pool_type,
+                     workers_count=workers) as reader:
+        rows = list(reader)
+    assert len(rows) == len(synthetic_dataset.data)
+    for row in rows:
+        _assert_rows_equal(row, _row_by_id(synthetic_dataset.data, row.id))
+
+
+def test_schema_fields_subset_regex(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=['id$', 'matrix$'],
+                     reader_pool_type='dummy') as reader:
+        row = next(reader)
+        assert set(row._fields) == {'id', 'matrix'}
+
+
+def test_schema_fields_subset_field_objects(synthetic_dataset):
+    with make_reader(synthetic_dataset.url,
+                     schema_fields=[TestSchema.id, TestSchema.id_float],
+                     reader_pool_type='dummy') as reader:
+        row = next(reader)
+        assert set(row._fields) == {'id', 'id_float'}
+
+
+@pytest.mark.parametrize('pool_type,workers', POOLS, ids=POOL_IDS)
+def test_predicate_pushdown(synthetic_dataset, pool_type, workers):
+    keep = {3, 14, 31, 41, 59}
+    with make_reader(synthetic_dataset.url, predicate=in_set(keep, 'id'),
+                     reader_pool_type=pool_type, workers_count=workers) as reader:
+        ids = {row.id for row in reader}
+    assert ids == keep
+
+
+def test_predicate_composition(synthetic_dataset):
+    pred = in_reduce([in_set(set(range(50)), 'id'),
+                      in_lambda(['id_odd'], lambda v: v['id_odd'])], all)
+    with make_reader(synthetic_dataset.url, predicate=pred,
+                     reader_pool_type='dummy') as reader:
+        ids = {row.id for row in reader}
+    assert ids == {i for i in range(50) if i % 2}
+
+
+def test_pseudorandom_split_is_partition(synthetic_dataset):
+    subsets = []
+    for index in range(2):
+        pred = in_pseudorandom_split([0.5, 0.5], index, 'id')
+        with make_reader(synthetic_dataset.url, predicate=pred,
+                         reader_pool_type='dummy') as reader:
+            subsets.append({row.id for row in reader})
+    assert subsets[0] | subsets[1] == {r['id'] for r in synthetic_dataset.data}
+    assert not subsets[0] & subsets[1]
+
+
+def test_sharding_union_disjoint(synthetic_dataset):
+    """Multi-node simulation: shards are disjoint and cover the dataset
+    (reference ``test_partition_multi_node``, test_end_to_end.py:446)."""
+    all_ids = []
+    for shard in range(3):
+        with make_reader(synthetic_dataset.url, cur_shard=shard, shard_count=3,
+                         shuffle_row_groups=False, reader_pool_type='dummy') as reader:
+            all_ids.append({row.id for row in reader})
+    union = set().union(*all_ids)
+    assert union == {r['id'] for r in synthetic_dataset.data}
+    for a in range(3):
+        for b in range(a + 1, 3):
+            assert not all_ids[a] & all_ids[b]
+
+
+def test_shard_requires_both_args(synthetic_dataset):
+    with pytest.raises(ValueError, match='together'):
+        make_reader(synthetic_dataset.url, cur_shard=0)
+
+
+def test_num_epochs(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, num_epochs=3,
+                     reader_pool_type='dummy') as reader:
+        rows = list(reader)
+    assert len(rows) == 3 * len(synthetic_dataset.data)
+
+
+def test_infinite_epochs_keep_streaming(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, num_epochs=None,
+                     reader_pool_type='thread', workers_count=2) as reader:
+        n = len(synthetic_dataset.data)
+        rows = [next(reader) for _ in range(2 * n + 5)]
+    assert len(rows) == 2 * n + 5
+
+
+def test_seeded_shuffle_reproducible(synthetic_dataset):
+    orders = []
+    for _ in range(2):
+        with make_reader(synthetic_dataset.url, shuffle_row_groups=True, seed=7,
+                         reader_pool_type='dummy') as reader:
+            orders.append([row.id for row in reader])
+    assert orders[0] == orders[1]
+
+
+def test_shuffle_changes_order(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, shuffle_row_groups=False,
+                     reader_pool_type='dummy') as reader:
+        unshuffled = [row.id for row in reader]
+    with make_reader(synthetic_dataset.url, shuffle_row_groups=True, seed=5,
+                     reader_pool_type='dummy') as reader:
+        shuffled = [row.id for row in reader]
+    assert sorted(shuffled) == sorted(unshuffled)
+    assert shuffled != unshuffled
+
+
+def test_shuffle_row_drop_partitions(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, shuffle_row_drop_partitions=3,
+                     reader_pool_type='dummy') as reader:
+        ids = sorted(row.id for row in reader)
+    assert ids == sorted(r['id'] for r in synthetic_dataset.data)
+
+
+def test_reset_after_drain(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                     workers_count=2) as reader:
+        first = sorted(row.id for row in reader)
+        reader.reset()
+        second = sorted(row.id for row in reader)
+    assert first == second
+
+
+def test_reset_mid_epoch_refused(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                     workers_count=2) as reader:
+        next(reader)
+        with pytest.raises(RuntimeError, match='fully consumed'):
+            reader.reset()
+
+
+def test_transform_spec_rows(synthetic_dataset):
+    def double_float(row):
+        row['id_float'] = row['id_float'] * 2
+        return row
+
+    spec = TransformSpec(double_float, selected_fields=['id', 'id_float'])
+    with make_reader(synthetic_dataset.url, transform_spec=spec,
+                     reader_pool_type='dummy') as reader:
+        for row in reader:
+            assert row.id_float == 2.0 * row.id
+            assert set(row._fields) == {'id', 'id_float'}
+
+
+def test_local_disk_cache(synthetic_dataset, tmp_path):
+    kwargs = dict(cache_type='local-disk', cache_location=str(tmp_path / 'cache'),
+                  cache_size_limit=1 << 30, reader_pool_type='thread', workers_count=2)
+    with make_reader(synthetic_dataset.url, num_epochs=2, **kwargs) as reader:
+        rows = list(reader)
+    assert len(rows) == 2 * len(synthetic_dataset.data)
+    # second reader is served from cache and still value-exact
+    with make_reader(synthetic_dataset.url, **kwargs) as reader:
+        for row in reader:
+            _assert_rows_equal(row, _row_by_id(synthetic_dataset.data, row.id))
+
+
+def test_cache_with_predicate_refused(synthetic_dataset, tmp_path):
+    with pytest.raises(RuntimeError, match='cache'):
+        make_reader(synthetic_dataset.url, predicate=in_set({1}, 'id'),
+                    cache_type='local-disk', cache_location=str(tmp_path / 'c'),
+                    cache_size_limit=1 << 20)
+
+
+def test_make_reader_on_foreign_store_raises(non_petastorm_dataset):
+    with pytest.raises(RuntimeError, match='make_batch_reader'):
+        make_reader(non_petastorm_dataset.url)
+
+
+# ---------------------------------------------------------------------------
+# make_batch_reader
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('pool_type,workers', POOLS, ids=POOL_IDS)
+def test_batch_reader_covers_all_rows(non_petastorm_dataset, pool_type, workers):
+    seen = []
+    with make_batch_reader(non_petastorm_dataset.url, reader_pool_type=pool_type,
+                           workers_count=workers) as reader:
+        for batch in reader:
+            assert isinstance(batch.id, np.ndarray)
+            seen.extend(batch.id.tolist())
+    assert sorted(seen) == [r['id'] for r in non_petastorm_dataset.data]
+
+
+def test_batch_reader_on_petastorm_dataset_scalars(scalar_dataset):
+    seen = {}
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy') as reader:
+        for batch in reader:
+            for i, row_id in enumerate(batch.id.tolist()):
+                seen[row_id] = batch.string[i]
+    assert len(seen) == len(scalar_dataset.data)
+    assert seen[3] == 'hello_3'
+
+
+def test_batch_reader_schema_fields_regex(non_petastorm_dataset):
+    with make_batch_reader(non_petastorm_dataset.url, schema_fields=['id'],
+                           reader_pool_type='dummy') as reader:
+        batch = next(reader)
+        assert set(batch._fields) == {'id'}
+
+
+def test_batch_reader_predicate(non_petastorm_dataset):
+    with make_batch_reader(non_petastorm_dataset.url,
+                           predicate=in_lambda(['id'], lambda v: v['id'] < 10),
+                           reader_pool_type='dummy') as reader:
+        ids = [i for batch in reader for i in batch.id.tolist()]
+    assert sorted(ids) == list(range(10))
+
+
+def test_batch_reader_transform_spec_pandas(non_petastorm_dataset):
+    def add_col(df):
+        df['value'] = df['value'] * 10
+        return df
+
+    spec = TransformSpec(add_col, selected_fields=['id', 'value'])
+    with make_batch_reader(non_petastorm_dataset.url, transform_spec=spec,
+                           reader_pool_type='dummy') as reader:
+        for batch in reader:
+            np.testing.assert_allclose(batch.value, batch.id * 15.0)
+
+
+def test_batch_reader_partitioned_filters(tmp_path):
+    from petastorm_tpu.test_util.dataset_gen import create_partitioned_dataset
+    url = 'file://' + str(tmp_path / 'partitioned')
+    data = create_partitioned_dataset(url, 30)
+    with make_batch_reader(url, filters=[('part', '=', 'p_1')],
+                           reader_pool_type='dummy') as reader:
+        ids = [i for batch in reader for i in batch.id.tolist()]
+    assert sorted(ids) == sorted(r['id'] for r in data if r['part'] == 'p_1')
+
+
+def test_batch_reader_partition_column_materialized(tmp_path):
+    from petastorm_tpu.test_util.dataset_gen import create_partitioned_dataset
+    url = 'file://' + str(tmp_path / 'partitioned2')
+    create_partitioned_dataset(url, 12)
+    with make_batch_reader(url, reader_pool_type='dummy') as reader:
+        for batch in reader:
+            assert len(set(batch.part.tolist())) == 1  # one partition per piece
+
+
+# ---------------------------------------------------------------------------
+# selectors / weighted sampling / errors
+# ---------------------------------------------------------------------------
+
+def test_rowgroup_selector(tmp_path):
+    from petastorm_tpu.etl.rowgroup_indexers import SingleFieldIndexer
+    from petastorm_tpu.etl.rowgroup_indexing import build_rowgroup_index
+    from petastorm_tpu.selectors import SingleIndexSelector
+    from petastorm_tpu.test_util.dataset_gen import create_test_dataset
+
+    url = 'file://' + str(tmp_path / 'indexed')
+    data = create_test_dataset(url, range(40), num_files=4)
+    build_rowgroup_index(url, [SingleFieldIndexer('by_partition_key', 'partition_key')])
+    with make_reader(url, rowgroup_selector=SingleIndexSelector('by_partition_key', ['p_3']),
+                     reader_pool_type='dummy') as reader:
+        ids = {row.id for row in reader}
+    # selector is row-group granular: must be a superset of matching rows
+    expected = {r['id'] for r in data if r['partition_key'] == 'p_3'}
+    assert expected <= ids
+    assert len(ids) < len(data)
+
+
+def test_weighted_sampling_reader(synthetic_dataset):
+    r1 = make_reader(synthetic_dataset.url, num_epochs=None, reader_pool_type='dummy')
+    r2 = make_reader(synthetic_dataset.url, num_epochs=None, reader_pool_type='dummy')
+    with WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=0) as mixed:
+        rows = [next(mixed) for _ in range(50)]
+    assert len(rows) == 50
+    assert mixed.schema is r1.schema
+
+
+def test_no_data_available(tmp_path):
+    from petastorm_tpu.test_util.dataset_gen import create_test_dataset
+    url = 'file://' + str(tmp_path / 'tiny')
+    create_test_dataset(url, range(4), num_files=1, row_group_size_mb=10)  # 1 row group
+    # a selector selecting nothing -> NoDataAvailableError
+    from petastorm_tpu.etl.rowgroup_indexers import SingleFieldIndexer
+    from petastorm_tpu.etl.rowgroup_indexing import build_rowgroup_index
+    from petastorm_tpu.selectors import SingleIndexSelector
+    build_rowgroup_index(url, [SingleFieldIndexer('by_partition_key', 'partition_key')])
+    with pytest.raises(NoDataAvailableError):
+        make_reader(url, rowgroup_selector=SingleIndexSelector('by_partition_key',
+                                                               ['no_such_value']),
+                    reader_pool_type='dummy')

@@ -1,0 +1,101 @@
+"""NGram unit + end-to-end tests (reference ``tests/test_ngram.py``,
+``tests/test_ngram_end_to_end.py``)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+SeqSchema = Unischema('SeqSchema', [
+    UnischemaField('ts', np.int64, (), ScalarCodec(), False),
+    UnischemaField('value', np.float32, (3,), NdarrayCodec(), False),
+    UnischemaField('label', np.int32, (), ScalarCodec(), False),
+])
+
+
+@pytest.fixture(scope='module')
+def seq_dataset(tmp_path_factory):
+    """Rows with timestamps 0..49 plus a gap: 60..79; single file, two row groups."""
+    path = tmp_path_factory.mktemp('seq') / 'ds'
+    url = 'file://' + str(path)
+    timestamps = list(range(50)) + list(range(60, 80))
+    rows = [{'ts': np.int64(t),
+             'value': np.full(3, t, dtype=np.float32),
+             'label': np.int32(t % 7)} for t in timestamps]
+    with materialize_dataset(url, SeqSchema, row_group_size_mb=100,
+                             rows_per_file=1000) as w:
+        w.write_rows(rows)
+    return url, rows
+
+
+def _make_ngram(length=3, delta_threshold=1, timestamp_overlap=True):
+    fields = {i: ['ts', 'value', 'label'] for i in range(length)}
+    return NGram(fields, delta_threshold=delta_threshold, timestamp_field='ts',
+                 timestamp_overlap=timestamp_overlap)
+
+
+def test_ngram_form_windows_unit():
+    ngram = _make_ngram(length=2, delta_threshold=1)
+    ngram.resolve_regex_field_names(SeqSchema)
+    rows = [{'ts': t, 'value': np.zeros(3, np.float32), 'label': np.int32(0)}
+            for t in [0, 1, 2, 10, 11]]
+    grams = ngram.form_ngram(rows, SeqSchema)
+    # (0,1),(1,2),(10,11) — the 2->10 gap exceeds the threshold
+    assert len(grams) == 3
+    assert [g[0].ts for g in grams] == [0, 1, 10]
+
+
+def test_ngram_offsets_must_be_consecutive():
+    with pytest.raises(ValueError, match='consecutive'):
+        NGram({0: ['a'], 2: ['b']}, delta_threshold=1, timestamp_field='ts')
+
+
+def test_ngram_non_overlap():
+    ngram = _make_ngram(length=2, delta_threshold=1, timestamp_overlap=False)
+    ngram.resolve_regex_field_names(SeqSchema)
+    rows = [{'ts': t, 'value': np.zeros(3, np.float32), 'label': np.int32(0)}
+            for t in range(6)]
+    grams = ngram.form_ngram(rows, SeqSchema)
+    assert [g[0].ts for g in grams] == [0, 2, 4]
+
+
+def test_ngram_per_timestep_fields():
+    ngram = NGram({0: ['ts', 'value'], 1: ['ts', 'label']}, delta_threshold=1,
+                  timestamp_field='ts')
+    ngram.resolve_regex_field_names(SeqSchema)
+    rows = [{'ts': t, 'value': np.zeros(3, np.float32), 'label': np.int32(t)}
+            for t in range(3)]
+    grams = ngram.form_ngram(rows, SeqSchema)
+    assert set(grams[0][0]._fields) == {'ts', 'value'}
+    assert set(grams[0][1]._fields) == {'ts', 'label'}
+
+
+@pytest.mark.parametrize('pool_type', ['dummy', 'thread'])
+def test_ngram_end_to_end(seq_dataset, pool_type):
+    url, rows = seq_dataset
+    ngram = _make_ngram(length=3, delta_threshold=1)
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type=pool_type, workers_count=2) as reader:
+        grams = list(reader)
+    # Validate window contents
+    for g in grams:
+        ts0 = g[0].ts
+        assert g[1].ts == ts0 + 1 and g[2].ts == ts0 + 2
+        np.testing.assert_array_equal(g[1].value, np.full(3, ts0 + 1, np.float32))
+    starts = sorted(g[0].ts for g in grams)
+    # contiguous runs 0..49 and 60..79 yield (50-2)+(20-2) windows
+    assert len(starts) == 48 + 18
+
+
+def test_ngram_regex_resolution(seq_dataset):
+    url, _ = seq_dataset
+    ngram = NGram({0: ['.*'], 1: ['ts']}, delta_threshold=1, timestamp_field='ts')
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type='dummy') as reader:
+        g = next(reader)
+        assert set(g[0]._fields) == {'ts', 'value', 'label'}
+        assert set(g[1]._fields) == {'ts'}

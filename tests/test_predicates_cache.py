@@ -1,0 +1,89 @@
+"""Unit tests for predicates and the local disk cache
+(reference ``tests/test_predicates.py``, ``tests/test_local_disk_cache.py``)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.cache import LocalDiskCache, NullCache
+from petastorm_tpu.predicates import (in_intersection, in_lambda, in_negate,
+                                      in_pseudorandom_split, in_reduce, in_set)
+
+
+class TestPredicates:
+    def test_in_set(self):
+        p = in_set({1, 2}, 'f')
+        assert p.do_include({'f': 1}) and not p.do_include({'f': 3})
+        assert p.get_fields() == ['f']
+
+    def test_in_intersection(self):
+        p = in_intersection({1, 2}, 'f')
+        assert p.do_include({'f': [2, 9]}) and not p.do_include({'f': [5]})
+
+    def test_in_lambda_with_state(self):
+        state = {'count': 0}
+
+        def count_and_pass(values, s):
+            s['count'] += 1
+            return True
+
+        p = in_lambda(['f'], count_and_pass, state)
+        assert p.do_include({'f': 1})
+        assert state['count'] == 1
+
+    def test_in_negate_and_reduce(self):
+        p = in_reduce([in_set({1}, 'a'), in_negate(in_set({2}, 'b'))], all)
+        assert sorted(p.get_fields()) == ['a', 'b']
+        assert p.do_include({'a': 1, 'b': 3})
+        assert not p.do_include({'a': 1, 'b': 2})
+
+    def test_pseudorandom_split_deterministic(self):
+        p0 = in_pseudorandom_split([0.3, 0.7], 0, 'f')
+        results = [p0.do_include({'f': i}) for i in range(1000)]
+        assert results == [p0.do_include({'f': i}) for i in range(1000)]
+        frac = sum(results) / 1000
+        assert 0.2 < frac < 0.4  # roughly 30%
+
+    def test_pseudorandom_split_validation(self):
+        with pytest.raises(ValueError):
+            in_pseudorandom_split([0.5, 0.5], 2, 'f')
+        with pytest.raises(ValueError):
+            in_pseudorandom_split([0.8, 0.8], 0, 'f')
+
+
+class TestLocalDiskCache:
+    def test_miss_then_hit(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path), 1 << 20)
+        calls = {'n': 0}
+
+        def fill():
+            calls['n'] += 1
+            return np.arange(10)
+
+        v1 = cache.get('k1', fill)
+        v2 = cache.get('k1', fill)
+        assert calls['n'] == 1
+        np.testing.assert_array_equal(v1, v2)
+
+    def test_eviction_under_size_limit(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path), size_limit_bytes=50_000)
+        for i in range(20):
+            cache.get('key_{}'.format(i), lambda i=i: np.full(1000, i))
+        assert cache.size_bytes() <= 60_000  # approximately bounded
+
+    def test_corrupt_entry_refilled(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path), 1 << 20)
+        cache.get('k', lambda: 42)
+        path = cache._key_path('k')
+        with open(path, 'wb') as f:
+            f.write(b'garbage')
+        assert cache.get('k', lambda: 43) == 43
+
+    def test_cleanup(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path / 'c'), 1 << 20, cleanup=True)
+        cache.get('k', lambda: 1)
+        cache.cleanup()
+        import os
+        assert not os.path.exists(str(tmp_path / 'c'))
+
+    def test_null_cache(self):
+        assert NullCache().get('k', lambda: 7) == 7
