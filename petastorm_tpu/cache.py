@@ -52,6 +52,7 @@ class LocalDiskCache(CacheBase):
         self._size_limit = size_limit_bytes
         self._shards = shards
         self._cleanup_on_exit = cleanup
+        self._approx_total = None  # running byte total, seeded by one scan
         for shard in range(shards):
             os.makedirs(os.path.join(path, 'shard_{:02d}'.format(shard)), exist_ok=True)
 
@@ -108,18 +109,26 @@ class LocalDiskCache(CacheBase):
                 yield full, st.st_size, st.st_mtime
 
     def _evict_if_needed(self, incoming_bytes: int) -> None:
+        # A full directory scan per store is O(cached entries) in syscalls;
+        # keep a running total (seeded by one scan) and only rescan when the
+        # counter crosses the limit. The counter may drift under concurrent
+        # writers — the rescan at eviction time corrects it.
+        if self._approx_total is None:
+            self._approx_total = sum(size for _, size, _ in self._entries())
+        self._approx_total += incoming_bytes
+        if self._approx_total <= self._size_limit:
+            return
         entries = list(self._entries())
         total = sum(size for _, size, _ in entries) + incoming_bytes
-        if total <= self._size_limit:
-            return
         for full, size, _ in sorted(entries, key=lambda e: e[2]):  # oldest first
+            if total <= self._size_limit:
+                break
             try:
                 os.remove(full)
                 total -= size
             except OSError:
                 pass
-            if total <= self._size_limit:
-                break
+        self._approx_total = total
 
     def size_bytes(self) -> int:
         return sum(size for _, size, _ in self._entries())

@@ -173,8 +173,12 @@ def _write_common_metadata(filesystem, dataset_path: str, schema: Unischema,
         pq.write_metadata(arrow_schema, f)
 
 
-def read_common_metadata(filesystem, dataset_path: str) -> Optional[Dict[bytes, bytes]]:
-    """Return the ``_common_metadata`` schema metadata dict, or None if absent."""
+def read_common_metadata(filesystem, dataset_path) -> Optional[Dict[bytes, bytes]]:
+    """Return the ``_common_metadata`` schema metadata dict, or None if absent.
+    A list of file paths (make_batch_reader url-list mode) never carries
+    dataset-level metadata."""
+    if isinstance(dataset_path, list):
+        return None
     meta_path = posixpath.join(dataset_path, _COMMON_METADATA)
     if not filesystem.exists(meta_path):
         return None
@@ -253,7 +257,12 @@ def materialize_dataset(dataset_url: str, schema: Unischema,
             'Metadata was generated but no row groups discovered at {}'.format(dataset_url))
 
 
-def _list_data_files(filesystem, dataset_path: str) -> List[str]:
+def _list_data_files(filesystem, dataset_path) -> List[str]:
+    """Data files of a dataset directory, or the explicit file list as-is
+    (make_batch_reader accepts a list of parquet file urls,
+    reference ``reader.py:52-58``)."""
+    if isinstance(dataset_path, list):
+        return sorted(dataset_path)
     files = [f for f in filesystem.find(dataset_path) if _is_data_file(f)]
     return sorted(files)
 
@@ -294,10 +303,14 @@ def load_row_groups(filesystem, dataset_path: str,
     pieces: List[RowGroupPiece] = []
     if not files:
         return pieces
+    is_file_list = isinstance(dataset_path, list)
     with ThreadPoolExecutor(max_workers=num_discovery_workers) as executor:
         for f, n, num_rows in executor.map(footer_row_groups, files):
-            rel = posixpath.relpath(f, dataset_path)
-            parts = tuple(sorted(_partition_values_from_relpath(rel).items()))
+            if is_file_list:
+                parts = ()   # explicit file lists carry no hive partition info
+            else:
+                rel = posixpath.relpath(f, dataset_path)
+                parts = tuple(sorted(_partition_values_from_relpath(rel).items()))
             for rg in range(n):
                 pieces.append(RowGroupPiece(path=f, row_group=rg, num_rows=num_rows[rg],
                                             partition_values=parts))
@@ -339,22 +352,22 @@ def read_dataset_arrow_schema(filesystem, dataset_path: str) -> pa.Schema:
         return pq.read_schema(f)
 
 
-def infer_or_load_unischema(filesystem, dataset_path: str) -> Tuple[Unischema, bool]:
+def infer_or_load_unischema(filesystem, dataset_path) -> Tuple[Unischema, bool]:
     """Load the stored Unischema, or infer one from the physical arrow schema
-    (foreign parquet stores). Returns ``(schema, was_stored)``
-    (reference ``etl/dataset_metadata.py:410-418``)."""
+    (foreign parquet stores or explicit file lists). Returns ``(schema,
+    was_stored)`` (reference ``etl/dataset_metadata.py:410-418``)."""
     try:
         return get_schema(filesystem, dataset_path), True
     except PetastormMetadataError:
         arrow_schema = read_dataset_arrow_schema(filesystem, dataset_path)
         schema = Unischema.from_arrow_schema(arrow_schema)
         # Hive partition columns live in directory names, not file schemas.
-        files = _list_data_files(filesystem, dataset_path)
         partition_keys: Dict[str, None] = {}
-        for f in files:
-            rel = posixpath.relpath(f, dataset_path)
-            for key in _partition_values_from_relpath(rel):
-                partition_keys[key] = None
+        if not isinstance(dataset_path, list):
+            for f in _list_data_files(filesystem, dataset_path):
+                rel = posixpath.relpath(f, dataset_path)
+                for key in _partition_values_from_relpath(rel):
+                    partition_keys[key] = None
         if partition_keys:
             from petastorm_tpu.unischema import UnischemaField
             extra = [UnischemaField(k, str, (), None, False) for k in partition_keys

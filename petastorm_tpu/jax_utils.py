@@ -1,0 +1,387 @@
+"""JAX/TPU adapter: turn a Reader into an iterator of device-ready batches.
+
+This replaces the reference's framework adapters (``petastorm/tf_utils.py``,
+``petastorm/pytorch.py``) with a TPU-first design:
+
+- Host side: rows/batches from the reader are sanitized to numpy, optionally
+  shuffled in a (batched) shuffling buffer, and assembled into fixed-size
+  column batches — all zero-copy where pyarrow/numpy allow.
+- Device side: ``make_jax_loader(..., mesh=...)`` builds global
+  ``jax.Array``s with ``jax.make_array_from_process_local_data`` over a
+  GSPMD mesh (each TPU host feeds only its own shard — the multi-host story
+  the reference delegated to Horovod env vars,
+  ``spark_dataset_converter.py:122-159``), and ``prefetch_to_device``
+  double-buffers host→HBM transfers so infeed overlaps compute (replacing
+  the reference's ``tf.py_func``/queue infeed, ``tf_utils.py:202-252``).
+
+Dtype policy (reference analogue ``tf_utils.py:27-44`` / ``pytorch.py:41-71``):
+JAX handles the full unsigned/bool range natively, so no uint16/uint32
+promotion is needed. Decimals are cast to float64; datetime64 to int64
+nanoseconds; strings/objects stay host-only and are excluded from device
+transfer unless the caller handles them.
+"""
+
+import collections
+import logging
+import threading
+from decimal import Decimal
+
+import numpy as np
+
+from petastorm_tpu.readers.shuffling_buffer import (
+    BatchedNoopShufflingBuffer, BatchedRandomShufflingBuffer,
+    NoopShufflingBuffer, RandomShufflingBuffer)
+
+logger = logging.getLogger(__name__)
+
+_DEVICE_INCOMPATIBLE_KINDS = ('U', 'S', 'O')  # unicode, bytes, python objects
+
+
+def _sanitize_value(value):
+    """Make a single field value numpy-native and JAX-friendly."""
+    if isinstance(value, Decimal):
+        return np.float64(value)
+    value = np.asarray(value)
+    if value.dtype.kind == 'M':  # datetime64 -> int64 ns since epoch
+        return value.astype('datetime64[ns]').astype(np.int64)
+    if value.dtype.kind == 'O' and value.size and isinstance(value.flat[0], Decimal):
+        return value.astype(np.float64)
+    return value
+
+
+def sanitize_jax_types(row_dict):
+    """In-place dtype sanitization of a row/batch dict for JAX consumption."""
+    for name, value in row_dict.items():
+        row_dict[name] = _sanitize_value(value)
+    return row_dict
+
+
+def _is_device_compatible(arr):
+    return getattr(arr, 'dtype', np.dtype(object)).kind not in _DEVICE_INCOMPATIBLE_KINDS
+
+
+class JaxLoaderBase(object):
+    """Iteration-state guard + auto-reset, mirroring the reference's
+    ``LoaderBase`` (``pytorch.py:104-129``)."""
+
+    def __init__(self, reader):
+        self.reader = reader
+        self._in_iter = None
+        self._error = None
+
+    def __iter__(self):
+        if self._error is not None:
+            raise RuntimeError('Cannot start a new iteration after a failed one') \
+                from self._error
+        if self._in_iter is not None and self._in_iter:
+            raise RuntimeError('Loader is already being iterated')
+        if self._in_iter is not None and not self._cache_hot():
+            self.reader.reset()
+            logger.warning('Start a new pass of the Reader. To avoid I/O, consider '
+                           'in-memory caching (inmemory_cache_all=True).')
+        self._in_iter = True
+        try:
+            for batch in self._iter_impl():
+                yield batch
+        except Exception as e:
+            self._error = e
+            raise
+        finally:
+            self._in_iter = False
+
+    def _iter_impl(self):
+        raise NotImplementedError
+
+    def _cache_hot(self):
+        """True when replay epochs are served from an in-memory cache and the
+        underlying reader need not be reset."""
+        return False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        self.join()
+
+    def stop(self):
+        self.reader.stop()
+
+    def join(self):
+        self.reader.join()
+
+
+class JaxDataLoader(JaxLoaderBase):
+    """Yields dicts of numpy column batches of exactly ``batch_size`` rows
+    (last partial batch dropped when ``drop_last``, else yielded short).
+
+    Works with both row-granular readers (``make_reader``) and batched readers
+    (``make_batch_reader``); batched input is fed column-wise into vectorized
+    buffers, never exploded into python rows (the perf trap the reference's
+    plain ``DataLoader`` falls into and ``BatchedDataLoader`` fixes,
+    ``pytorch.py:204-216`` vs ``:352-408``).
+
+    :param shuffling_queue_capacity: 0 disables shuffling; otherwise a
+        uniform-shuffling buffer of that many rows decorrelates row-group order.
+    :param transform_fn: optional callable applied to each finished batch dict.
+    :param inmemory_cache_all: cache epoch-1 batches and replay them for
+        subsequent epochs without touching the reader (reference
+        ``pytorch.py:292-321``).
+    """
+
+    def __init__(self, reader, batch_size=1, shuffling_queue_capacity=0,
+                 transform_fn=None, drop_last=False, seed=None,
+                 inmemory_cache_all=False):
+        super(JaxDataLoader, self).__init__(reader)
+        if getattr(reader, 'ngram', None) is not None:
+            # NGram rows are {offset: namedtuple} dicts; batching them needs
+            # per-timestep collation this loader does not implement (the
+            # reference torch loader refuses them too, pytorch.py:150-152).
+            raise NotImplementedError(
+                'JaxDataLoader does not support NGram readers; iterate the '
+                'reader directly or use a TransformSpec to flatten windows')
+        self.batch_size = batch_size
+        self.shuffling_queue_capacity = shuffling_queue_capacity
+        self.transform_fn = transform_fn
+        self.drop_last = drop_last
+        self.seed = seed
+        self.inmemory_cache_all = inmemory_cache_all
+        self._cache = [] if inmemory_cache_all else None
+        self._cache_complete = False
+
+    def _cache_hot(self):
+        return self._cache_complete
+
+    # -- buffer construction -------------------------------------------------
+    def _make_batched_buffer(self):
+        if self.shuffling_queue_capacity > 0:
+            min_after = max(1, self.shuffling_queue_capacity - self.batch_size)
+            return BatchedRandomShufflingBuffer(
+                self.shuffling_queue_capacity + self.batch_size,
+                min_after_retrieve=min_after, batch_size=self.batch_size,
+                seed=self.seed)
+        return BatchedNoopShufflingBuffer(self.batch_size)
+
+    def _iter_impl(self):
+        if self._cache_complete:
+            for batch in self._cache:
+                yield batch
+            return
+        if self.reader.batched_output:
+            gen = self._iter_batched()
+        else:
+            gen = self._iter_rows()
+        for batch in gen:
+            if self.transform_fn is not None:
+                batch = self.transform_fn(batch)
+            if self._cache is not None:
+                self._cache.append(batch)
+            yield batch
+        if self._cache is not None:
+            self._cache_complete = True
+
+    def _iter_batched(self):
+        buffer = self._make_batched_buffer()
+        for chunk in self.reader:
+            columns = sanitize_jax_types(chunk._asdict()
+                                         if hasattr(chunk, '_asdict') else dict(chunk))
+            while not buffer.can_add():
+                yield buffer.retrieve()
+            buffer.add_many(columns)
+            while buffer.can_retrieve() and buffer.size >= self.batch_size:
+                yield buffer.retrieve()
+        buffer.finish()
+        while buffer.can_retrieve():
+            batch = buffer.retrieve()
+            n = len(next(iter(batch.values())))
+            if n == self.batch_size or not self.drop_last:
+                yield batch
+
+    def _iter_rows(self):
+        if self.shuffling_queue_capacity > 0:
+            min_after = max(1, self.shuffling_queue_capacity - 1)
+            buffer = RandomShufflingBuffer(
+                self.shuffling_queue_capacity, min_after_retrieve=min_after,
+                seed=self.seed)
+        else:
+            buffer = NoopShufflingBuffer()
+        pending = []
+
+        def drain(final):
+            rows = pending
+            while buffer.can_retrieve():
+                rows.append(buffer.retrieve())
+                if len(rows) == self.batch_size:
+                    yield self._collate(rows)
+                    rows.clear()
+            if final and rows and not self.drop_last:
+                yield self._collate(rows)
+
+        for row in self.reader:
+            row = sanitize_jax_types(row._asdict()
+                                     if hasattr(row, '_asdict') else dict(row))
+            while not buffer.can_add():
+                for b in drain(False):
+                    yield b
+                if not buffer.can_retrieve():
+                    break
+            buffer.add_many([row])
+            for b in drain(False):
+                yield b
+        buffer.finish()
+        for b in drain(True):
+            yield b
+
+    @staticmethod
+    def _collate(rows):
+        keys = rows[0].keys()
+        out = {}
+        for k in keys:
+            vals = [np.asarray(r[k]) for r in rows]
+            shapes = {v.shape for v in vals}
+            kinds = {v.dtype.kind for v in vals}
+            if len(shapes) == 1 and not (kinds & set(_DEVICE_INCOMPATIBLE_KINDS)):
+                out[k] = np.stack(vals)
+            else:
+                # Ragged (shape=(None,...)) or string/object fields cannot form a
+                # dense device batch; keep them as a host-side object column.
+                col = np.empty(len(vals), dtype=object)
+                for i, v in enumerate(vals):
+                    col[i] = v
+                out[k] = col
+        return out
+
+
+class ShardedJaxLoader(JaxLoaderBase):
+    """Wraps a ``JaxDataLoader`` and lifts each host-local numpy batch into a
+    **global** ``jax.Array`` sharded over ``mesh`` along ``batch_axis``.
+
+    Under multi-host TPU each process constructs only its local shard
+    (``local_batch_size = global_batch_size // process_count``) and XLA sees one
+    logical array — the idiomatic replacement for the reference's static
+    rank/size shard arithmetic. ``drop_last`` is forced True so every host
+    yields the same number of steps and collective programs never deadlock on
+    ragged epochs (SURVEY §7 "hard parts").
+
+    String/object columns cannot live in HBM; they are returned under
+    ``batch['_host']`` untouched.
+    """
+
+    def __init__(self, reader, mesh, local_batch_size, batch_axis='data',
+                 shuffling_queue_capacity=0, transform_fn=None, seed=None,
+                 inmemory_cache_all=False):
+        super(ShardedJaxLoader, self).__init__(reader)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        self._jax = jax
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self._loader = JaxDataLoader(
+            reader, batch_size=local_batch_size,
+            shuffling_queue_capacity=shuffling_queue_capacity,
+            transform_fn=transform_fn, drop_last=True, seed=seed,
+            inmemory_cache_all=inmemory_cache_all)
+        self._pspec = PartitionSpec(batch_axis)
+        self._named_sharding = NamedSharding(mesh, self._pspec)
+
+    def _cache_hot(self):
+        return self._loader._cache_hot()
+
+    def _iter_impl(self):
+        jax = self._jax
+        for batch in self._loader._iter_impl():
+            device, host = {}, {}
+            for name, value in batch.items():
+                if _is_device_compatible(value):
+                    device[name] = jax.make_array_from_process_local_data(
+                        self._named_sharding, value)
+                else:
+                    host[name] = value
+            if host:
+                device['_host'] = host
+            yield device
+
+
+def make_jax_loader(reader, batch_size=1, mesh=None, batch_axis='data',
+                    shuffling_queue_capacity=0, transform_fn=None,
+                    drop_last=False, seed=None, inmemory_cache_all=False):
+    """Factory: plain host loader when ``mesh is None``, else a sharded loader.
+
+    With a mesh, ``batch_size`` is the **per-process** batch size; the global
+    logical batch is ``batch_size * jax.process_count()``.
+    """
+    if mesh is None:
+        return JaxDataLoader(reader, batch_size=batch_size,
+                             shuffling_queue_capacity=shuffling_queue_capacity,
+                             transform_fn=transform_fn, drop_last=drop_last,
+                             seed=seed, inmemory_cache_all=inmemory_cache_all)
+    return ShardedJaxLoader(reader, mesh, batch_size, batch_axis=batch_axis,
+                            shuffling_queue_capacity=shuffling_queue_capacity,
+                            transform_fn=transform_fn, seed=seed,
+                            inmemory_cache_all=inmemory_cache_all)
+
+
+def prefetch_to_device(iterator, size=2, sharding=None):
+    """Double-buffered host→device prefetch.
+
+    Stages up to ``size`` batches ahead of the consumer on a background thread
+    so the ``jax.device_put`` (host→HBM DMA) of batch N+1 overlaps the compute
+    of batch N. When batches are already global ``jax.Array``s (from
+    ``ShardedJaxLoader``) the transfer has been issued at construction time and
+    this just provides pipelining depth.
+
+    :param sharding: optional ``jax.sharding.Sharding`` applied via
+        ``jax.device_put`` to plain numpy batches.
+    """
+    import jax
+
+    queue = collections.deque()
+    done = object()
+    cv = threading.Condition()
+    state = {'error': None, 'finished': False}
+
+    def put(batch):
+        if sharding is None:
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x) if _is_device_compatible(np.asarray(x)) else x,
+                batch)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding) if _is_device_compatible(np.asarray(x)) else x,
+            batch)
+
+    def producer():
+        try:
+            for batch in iterator:
+                staged = put(batch)
+                with cv:
+                    while len(queue) >= size and not state['finished']:
+                        cv.wait()
+                    queue.append(staged)
+                    cv.notify_all()
+        except Exception as e:  # propagate into the consumer
+            state['error'] = e
+        finally:
+            with cv:
+                queue.append(done)
+                cv.notify_all()
+
+    thread = threading.Thread(target=producer, daemon=True,
+                              name='petastorm-tpu-prefetch')
+    thread.start()
+    try:
+        while True:
+            with cv:
+                while not queue:
+                    cv.wait()
+                item = queue.popleft()
+                cv.notify_all()
+            if item is done:
+                if state['error'] is not None:
+                    raise state['error']
+                return
+            yield item
+    finally:
+        with cv:
+            state['finished'] = True
+            queue.clear()
+            cv.notify_all()

@@ -32,6 +32,7 @@ from petastorm_tpu.readers.batch_worker import ArrowBatchWorker, BatchResultsRea
 from petastorm_tpu.readers.row_worker import RowGroupResultsReader, RowGroupWorker
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.unischema import match_unischema_fields
+from petastorm_tpu.utils import cast_partition_value, cast_string_to_type
 from petastorm_tpu.workers import EmptyResultError
 from petastorm_tpu.workers.dummy_pool import DummyPool
 from petastorm_tpu.workers.process_pool import ProcessPool
@@ -238,6 +239,7 @@ class Reader:
         pieces, worker_predicate = self._filter_row_groups(
             filesystem, all_pieces, stored_schema, predicate, rowgroup_selector,
             filters, cur_shard, shard_count)
+        del all_pieces
         if not pieces:
             raise NoDataAvailableError(
                 'No row groups left after predicate/selector/shard filtering at '
@@ -280,6 +282,10 @@ class Reader:
 
     def _filter_row_groups(self, filesystem, pieces, stored_schema, predicate,
                            rowgroup_selector, filters, cur_shard, shard_count):
+        # Row-group indexes (rowgroup_selector) are built over the full
+        # load_row_groups() ordering; carry each piece's original ordinal so
+        # selection stays aligned after predicate/filters pruning.
+        indexed = list(enumerate(pieces))
         worker_predicate = None
         if predicate is not None:
             predicate_fields = set(predicate.get_fields())
@@ -290,14 +296,14 @@ class Reader:
             if predicate_fields and predicate_fields <= partition_keys:
                 # Evaluate on partition values only: prune pieces with no reads
                 # (reference reader.py:577-608).
-                pieces = [p for p in pieces if predicate.do_include(
+                indexed = [(i, p) for i, p in indexed if predicate.do_include(
                     {f: _cast_partition(stored_schema, f, p.partition_dict[f])
                      for f in predicate_fields})]
             else:
                 worker_predicate = predicate
 
         if filters is not None:
-            pieces = [p for p in pieces if _piece_passes_filters(
+            indexed = [(i, p) for i, p in indexed if _piece_passes_filters(
                 p, filters, stored_schema)]
 
         if rowgroup_selector is not None:
@@ -308,8 +314,9 @@ class Reader:
                 raise ValueError('Selector references unknown indexes: {}'.format(
                     sorted(missing)))
             selected = rowgroup_selector.select_row_groups(indexes)
-            pieces = [p for i, p in enumerate(pieces) if i in selected]
+            indexed = [(i, p) for i, p in indexed if i in selected]
 
+        pieces = [p for _, p in indexed]
         if cur_shard is not None:
             if len(pieces) < shard_count:
                 warnings.warn(
@@ -370,12 +377,7 @@ class Reader:
 
 def _cast_partition(schema, field_name, value):
     field = schema.fields.get(field_name)
-    if field is None or field.numpy_dtype is str:
-        return value
-    if field.numpy_dtype is bytes:
-        return value.encode('utf-8')
-    import numpy as np
-    return np.dtype(field.numpy_dtype).type(value)
+    return cast_partition_value(field.numpy_dtype if field is not None else None, value)
 
 
 _FILTER_OPS = {
@@ -414,7 +416,7 @@ def _piece_passes_filters(piece, filters, schema) -> bool:
             # cast to the filter value's type when partition value is a string
             if isinstance(actual, str) and not isinstance(val, str) \
                     and not isinstance(val, (list, tuple, set)):
-                actual = type(val)(actual)
+                actual = cast_string_to_type(type(val), actual)
             if not _FILTER_OPS[op](actual, val):
                 ok = False
                 break

@@ -16,6 +16,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from petastorm_tpu.utils import cast_partition_value
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 
@@ -91,7 +92,7 @@ class ArrowBatchWorker(WorkerBase):
             table = self._load_table_with_predicate(piece, worker_predicate)
         else:
             cache_key = 'batch:{}:{}:{}'.format(
-                hashlib.md5(self._dataset_path.encode()).hexdigest(), piece.path,
+                hashlib.md5(str(self._dataset_path).encode()).hexdigest(), piece.path,
                 piece.row_group)
             table = self._local_cache.get(cache_key, lambda: self._load_table(piece))
         if table is None or table.num_rows == 0:
@@ -116,11 +117,11 @@ class ArrowBatchWorker(WorkerBase):
         for key, value in piece.partition_dict.items():
             if key in self._schema.fields and key not in table.column_names:
                 field = self._schema.fields[key]
+                typed = cast_partition_value(field.numpy_dtype, value)
                 if field.numpy_dtype is str:
-                    arr = pa.array([value] * table.num_rows, type=pa.string())
+                    arr = pa.array([typed] * table.num_rows, type=pa.string())
                 else:
-                    typed = np.full(table.num_rows, np.dtype(field.numpy_dtype).type(value))
-                    arr = pa.array(typed)
+                    arr = pa.array(np.full(table.num_rows, typed))
                 table = table.append_column(key, arr)
         return table
 
@@ -132,23 +133,33 @@ class ArrowBatchWorker(WorkerBase):
 
     def _load_table_with_predicate(self, piece, predicate) -> pa.Table:
         """Vectorized predicate: read predicate columns, build a boolean mask,
-        then read+filter the remaining columns (reference :229-288)."""
+        then read only the *remaining* columns and join them with the
+        already-loaded predicate columns — each column is read exactly once
+        (reference :229-288)."""
         predicate_fields = predicate.get_fields()
         unknown = set(predicate_fields) - set(self._schema.fields.keys())
         if unknown:
             raise ValueError('Predicate uses unknown fields: {}'.format(sorted(unknown)))
         pf = self._parquet_file(piece.path)
-        pred_table = pf.read_row_group(
+        pred_stored = pf.read_row_group(
             piece.row_group, columns=self._stored_columns(predicate_fields, piece))
-        pred_table = self._append_partition_columns(pred_table, piece)
+        pred_table = self._append_partition_columns(pred_stored, piece)
         pred_data = {name: pred_table.column(name).to_pylist() for name in predicate_fields}
         mask = [predicate.do_include({f: pred_data[f][i] for f in predicate_fields})
                 for i in range(pred_table.num_rows)]
         if not any(mask):
             return None
         indices = np.nonzero(mask)[0]
-        full = self._load_table(piece)
-        return full.take(pa.array(indices))
+        other_names = [n for n in self._schema.fields if n not in set(predicate_fields)]
+        combined = pred_stored
+        other_stored = self._stored_columns(other_names, piece)
+        if other_stored:
+            rest = pf.read_row_group(piece.row_group, columns=other_stored)
+            for name in rest.column_names:
+                combined = combined.append_column(name, rest.column(name))
+        combined = self._append_partition_columns(combined, piece)
+        ordered = [n for n in self._schema.fields if n in combined.column_names]
+        return combined.select(ordered).take(pa.array(indices))
 
     # -- transform -------------------------------------------------------------
 

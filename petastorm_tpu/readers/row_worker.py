@@ -17,19 +17,13 @@ import numpy as np
 import pyarrow.parquet as pq
 
 from petastorm_tpu.unischema import decode_row
+from petastorm_tpu.utils import cast_partition_value
 from petastorm_tpu.workers import EmptyResultError
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 
 def _cast_partition_value(field, value: str):
-    if field is None:
-        return value
-    dtype = field.numpy_dtype
-    if dtype is str:
-        return value
-    if dtype is bytes:
-        return value.encode('utf-8')
-    return np.dtype(dtype).type(value)
+    return cast_partition_value(field.numpy_dtype if field is not None else None, value)
 
 
 class RowGroupResultsReader:
@@ -101,7 +95,7 @@ class RowGroupWorker(WorkerBase):
 
     def _cache_key(self, piece) -> str:
         return 'rowgroup:{}:{}:{}'.format(
-            hashlib.md5(self._dataset_path.encode()).hexdigest(), piece.path, piece.row_group)
+            hashlib.md5(str(self._dataset_path).encode()).hexdigest(), piece.path, piece.row_group)
 
     def _storage_columns(self, field_names, piece) -> List[str]:
         """Columns to physically read: requested fields minus partition-derived."""

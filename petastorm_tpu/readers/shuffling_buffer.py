@@ -1,0 +1,286 @@
+"""Shuffling buffers decorrelating the row-group read order from the yield order.
+
+Capability parity with the reference's ``petastorm/reader_impl/shuffling_buffer.py``
+(row-granular buffers) and ``petastorm/reader_impl/pytorch_shuffling_buffer.py``
+(batched, column-major buffers) — but the batched variants here are numpy-native
+so they can feed JAX/TPU pipelines (the host-side representation for a TPU input
+pipeline is a numpy array; framework adapters convert at the edge).
+
+Design notes:
+- ``RandomShufflingBuffer`` uses the same O(1) random-pop-with-swap trick as the
+  reference (``shuffling_buffer.py:94-180``): sample an index, swap the sampled
+  item with the last, pop.
+- ``BatchedRandomShufflingBuffer`` keeps whole columns as numpy arrays and
+  samples a random permutation to slice batches from (reference algorithm doc at
+  ``pytorch_shuffling_buffer.py:180-206``), which vectorizes shuffling instead
+  of doing per-row python work.
+"""
+
+import collections
+
+import numpy as np
+
+
+class ShufflingBufferBase(object):
+    """Row-granular buffer protocol (reference ``shuffling_buffer.py:22-58``)."""
+
+    def add_many(self, items):
+        raise NotImplementedError
+
+    def retrieve(self):
+        raise NotImplementedError
+
+    def can_add(self):
+        raise NotImplementedError
+
+    def can_retrieve(self):
+        raise NotImplementedError
+
+    @property
+    def size(self):
+        raise NotImplementedError
+
+    def finish(self):
+        """Signal end of stream: buffer may drain below its decorrelation floor."""
+        raise NotImplementedError
+
+
+class NoopShufflingBuffer(ShufflingBufferBase):
+    """Pass-through FIFO (reference ``shuffling_buffer.py:61-91``)."""
+
+    def __init__(self):
+        self._queue = collections.deque()
+        self._done = False
+
+    def add_many(self, items):
+        self._queue.extend(items)
+
+    def retrieve(self):
+        return self._queue.popleft()
+
+    def can_add(self):
+        return not self._done
+
+    def can_retrieve(self):
+        return len(self._queue) > 0
+
+    @property
+    def size(self):
+        return len(self._queue)
+
+    def finish(self):
+        self._done = True
+
+
+class RandomShufflingBuffer(ShufflingBufferBase):
+    """Bounded uniform-shuffling buffer (reference ``shuffling_buffer.py:94-180``).
+
+    :param shuffling_buffer_capacity: soft capacity; ``can_add`` turns False at or
+        above it (a single ``add_many`` may overshoot, as in the reference).
+    :param min_after_retrieve: ``can_retrieve`` requires at least this many items
+        buffered (decorrelation floor) until ``finish()`` is called.
+    :param extra_capacity: headroom for the overshoot case.
+    """
+
+    def __init__(self, shuffling_buffer_capacity, min_after_retrieve,
+                 extra_capacity=1000, seed=None):
+        self._capacity = shuffling_buffer_capacity
+        self._min_after_retrieve = min_after_retrieve
+        self._items = [None] * (shuffling_buffer_capacity + extra_capacity)
+        self._size = 0
+        self._done_adding = False
+        self._random = np.random.RandomState(seed)
+
+    def add_many(self, items):
+        if self._done_adding:
+            raise RuntimeError('Cannot add to a finished shuffling buffer')
+        if not self.can_add():
+            raise RuntimeError('Buffer is over capacity; check can_add() first')
+        needed = self._size + len(items)
+        if needed > len(self._items):
+            self._items.extend([None] * (needed - len(self._items)))
+        for item in items:
+            self._items[self._size] = item
+            self._size += 1
+
+    def retrieve(self):
+        if not self.can_retrieve():
+            raise RuntimeError('Not enough items in the buffer; check can_retrieve()')
+        idx = self._random.randint(self._size)
+        item = self._items[idx]
+        self._size -= 1
+        self._items[idx] = self._items[self._size]
+        self._items[self._size] = None
+        return item
+
+    def can_add(self):
+        return self._size < self._capacity and not self._done_adding
+
+    def can_retrieve(self):
+        floor = 1 if self._done_adding else self._min_after_retrieve
+        return self._size >= floor
+
+    @property
+    def size(self):
+        return self._size
+
+    def finish(self):
+        self._done_adding = True
+
+
+class BatchedBufferBase(object):
+    """Column-major buffer protocol: add dicts of column arrays, retrieve
+    fixed-size batches (reference ``pytorch_shuffling_buffer.py:23-83``)."""
+
+    def __init__(self, batch_size):
+        self._batch_size = batch_size
+        self._done_adding = False
+        self._size = 0
+
+    def add_many(self, columns):
+        raise NotImplementedError
+
+    def retrieve(self):
+        raise NotImplementedError
+
+    def can_add(self):
+        return not self._done_adding
+
+    def can_retrieve(self):
+        raise NotImplementedError
+
+    @property
+    def size(self):
+        return self._size
+
+    def finish(self):
+        self._done_adding = True
+
+
+class BatchedNoopShufflingBuffer(BatchedBufferBase):
+    """Re-chunks incoming column batches into fixed-size batches, preserving
+    order (reference ``pytorch_shuffling_buffer.py:111-159``)."""
+
+    def __init__(self, batch_size):
+        super(BatchedNoopShufflingBuffer, self).__init__(batch_size)
+        self._chunks = collections.deque()   # deque of dict[str, ndarray]
+        self._keys = None
+
+    def add_many(self, columns):
+        if self._done_adding:
+            raise RuntimeError('Cannot add to a finished buffer')
+        columns = {k: np.asarray(v) for k, v in columns.items()}
+        if self._keys is None:
+            self._keys = list(columns.keys())
+        n = len(next(iter(columns.values())))
+        if n:
+            self._chunks.append(columns)
+            self._size += n
+
+    def retrieve(self):
+        if not self.can_retrieve():
+            raise RuntimeError('Not enough rows buffered; check can_retrieve()')
+        want = min(self._batch_size, self._size)
+        parts = collections.defaultdict(list)
+        got = 0
+        while got < want:
+            chunk = self._chunks[0]
+            avail = len(next(iter(chunk.values())))
+            take = min(avail, want - got)
+            if take == avail:
+                self._chunks.popleft()
+                for k, v in chunk.items():
+                    parts[k].append(v)
+            else:
+                for k, v in chunk.items():
+                    parts[k].append(v[:take])
+                self._chunks[0] = {k: v[take:] for k, v in chunk.items()}
+            got += take
+        self._size -= got
+        return {k: (v[0] if len(v) == 1 else np.concatenate(v)) for k, v in parts.items()}
+
+    def can_retrieve(self):
+        if self._done_adding:
+            return self._size > 0
+        return self._size >= self._batch_size
+
+
+class BatchedRandomShufflingBuffer(BatchedBufferBase):
+    """Vectorized shuffling buffer over column arrays.
+
+    Keeps one pre-allocated numpy array per column; on ``retrieve`` draws a
+    fresh random permutation head of ``batch_size`` indices, yields those rows
+    and compacts by swapping the tail into the holes — the numpy translation of
+    the reference's torch implementation (``pytorch_shuffling_buffer.py:162-304``).
+    """
+
+    def __init__(self, shuffling_buffer_capacity, min_after_retrieve, batch_size,
+                 seed=None):
+        super(BatchedRandomShufflingBuffer, self).__init__(batch_size)
+        self._capacity = shuffling_buffer_capacity
+        self._min_after_retrieve = min_after_retrieve
+        self._random = np.random.RandomState(seed)
+        self._columns = None     # dict[str, ndarray] with capacity rows
+        self._extra = collections.deque()  # overflow chunks not yet merged
+
+    def can_add(self):
+        return self._size < self._capacity and not self._done_adding
+
+    def can_retrieve(self):
+        floor = 1 if self._done_adding else max(self._min_after_retrieve, self._batch_size)
+        return self._size >= floor
+
+    def _ensure_storage(self, columns):
+        if self._columns is None:
+            self._columns = {}
+            for k, v in columns.items():
+                shape = (self._capacity,) + v.shape[1:]
+                self._columns[k] = np.empty(shape, dtype=v.dtype)
+
+    def add_many(self, columns):
+        if self._done_adding:
+            raise RuntimeError('Cannot add to a finished buffer')
+        if not self.can_add():
+            raise RuntimeError('Buffer is over capacity; check can_add() first')
+        columns = {k: np.asarray(v) for k, v in columns.items()}
+        n = len(next(iter(columns.values())))
+        if n == 0:
+            return
+        self._ensure_storage(columns)
+        fit = min(n, self._capacity - self._size)
+        for k, v in columns.items():
+            self._columns[k][self._size:self._size + fit] = v[:fit]
+        if fit < n:
+            # Overshoot tolerated as in the reference: spill to a side deque
+            # merged back as space frees up.
+            self._extra.append({k: v[fit:] for k, v in columns.items()})
+        self._size += n
+
+    def _merge_extra(self):
+        stored = self._size - sum(len(next(iter(c.values()))) for c in self._extra)
+        while self._extra and stored < self._capacity:
+            chunk = self._extra[0]
+            n = len(next(iter(chunk.values())))
+            fit = min(n, self._capacity - stored)
+            for k, v in chunk.items():
+                self._columns[k][stored:stored + fit] = v[:fit]
+            if fit < n:
+                self._extra[0] = {k: v[fit:] for k, v in chunk.items()}
+            else:
+                self._extra.popleft()
+            stored += fit
+
+    def retrieve(self):
+        if not self.can_retrieve():
+            raise RuntimeError('Not enough rows buffered; check can_retrieve()')
+        stored = self._size - sum(len(next(iter(c.values()))) for c in self._extra)
+        want = min(self._batch_size, stored)
+        perm = self._random.permutation(stored)
+        take, rest = perm[:want], perm[want:]
+        batch = {k: v[take].copy() for k, v in self._columns.items()}
+        # Compact: move surviving rows to the front (vectorized gather).
+        for k in self._columns:
+            self._columns[k][:len(rest)] = self._columns[k][rest]
+        self._size -= want
+        self._merge_extra()
+        return batch

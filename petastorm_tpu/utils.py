@@ -1,0 +1,40 @@
+"""Small shared helpers used across reader/worker modules.
+
+Reference analogue: ``petastorm/utils.py`` (its ``decode_row`` lives on
+``unischema.decode_row`` here; this module holds cross-cutting value casts).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_FALSY_STRINGS = frozenset(('false', '0', '', 'no'))
+
+
+def parse_bool_string(value: str) -> bool:
+    """Parse a hive-partition-style boolean string. ``bool('False')`` is True in
+    python, which silently inverts ``flag=False`` partitions — hence this."""
+    return value.strip().lower() not in _FALSY_STRINGS
+
+
+def cast_partition_value(numpy_dtype, value: str):
+    """Cast a hive partition directory value (always a string on disk) to the
+    schema field's dtype. Single source of truth for partition-value coercion
+    (used by the reader's partition-predicate pruning, the row worker, and the
+    batch worker)."""
+    if numpy_dtype is None or numpy_dtype is str:
+        return value
+    if numpy_dtype is bytes:
+        return value.encode('utf-8')
+    dtype = np.dtype(numpy_dtype)
+    if dtype.kind == 'b':
+        return np.bool_(parse_bool_string(value))
+    return dtype.type(value)
+
+
+def cast_string_to_type(target_type, value: str):
+    """Cast a string to ``type(filter_value)`` for filter comparison, with
+    correct bool semantics."""
+    if target_type is bool:
+        return parse_bool_string(value)
+    return target_type(value)

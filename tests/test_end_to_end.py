@@ -318,3 +318,65 @@ def test_no_data_available(tmp_path):
         make_reader(url, rowgroup_selector=SingleIndexSelector('by_partition_key',
                                                                ['no_such_value']),
                     reader_pool_type='dummy')
+
+
+# ---------------------------------------------------------------------------
+# regression tests (code-review findings)
+# ---------------------------------------------------------------------------
+
+def test_batch_reader_list_of_file_urls(non_petastorm_dataset):
+    """make_batch_reader accepts an explicit list of parquet file urls
+    (reference reader.py:52-58)."""
+    import fsspec
+    fs = fsspec.filesystem('file')
+    files = sorted(f for f in fs.find(non_petastorm_dataset.path)
+                   if f.endswith('.parquet'))
+    assert len(files) >= 2
+    urls = ['file://' + f for f in files]
+    with make_batch_reader(urls, reader_pool_type='dummy') as reader:
+        ids = [i for batch in reader for i in batch.id.tolist()]
+    assert sorted(ids) == sorted(r['id'] for r in non_petastorm_dataset.data)
+
+    # a subset of files yields the subset of rows
+    with make_batch_reader(urls[:1], reader_pool_type='dummy') as reader:
+        subset_ids = [i for batch in reader for i in batch.id.tolist()]
+    assert set(subset_ids) < set(ids)
+
+
+def test_bool_partition_filter(tmp_path):
+    """bool('False') is True; filters on bool-typed partition values must parse
+    the string properly."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = tmp_path / 'boolpart'
+    for flag in ('true', 'false'):
+        d = path / 'flag={}'.format(flag)
+        d.mkdir(parents=True)
+        ids = [1, 2] if flag == 'true' else [3, 4]
+        pq.write_table(pa.table({'id': ids}), d / 'part0.parquet')
+    url = 'file://' + str(path)
+    with make_batch_reader(url, filters=[('flag', '=', False)],
+                           reader_pool_type='dummy') as reader:
+        ids = [i for batch in reader for i in batch.id.tolist()]
+    assert sorted(ids) == [3, 4]
+
+
+def test_selector_aligned_after_filter_pruning(tmp_path):
+    """Row-group index ordinals are global; pruning by filters must not shift
+    which row groups a selector picks."""
+    from petastorm_tpu.etl.rowgroup_indexers import SingleFieldIndexer
+    from petastorm_tpu.etl.rowgroup_indexing import build_rowgroup_index
+    from petastorm_tpu.selectors import SingleIndexSelector
+    from petastorm_tpu.test_util.dataset_gen import create_test_dataset
+
+    url = 'file://' + str(tmp_path / 'indexed_pruned')
+    data = create_test_dataset(url, range(40), num_files=4)
+    build_rowgroup_index(url, [SingleFieldIndexer('by_pk', 'partition_key')])
+    with make_reader(url, rowgroup_selector=SingleIndexSelector('by_pk', ['p_3']),
+                     predicate=in_lambda(['id'], lambda values: values['id'] < 100),
+                     reader_pool_type='dummy') as reader:
+        ids = {row.id for row in reader}
+    expected = {r['id'] for r in data if r['partition_key'] == 'p_3'}
+    assert expected <= ids
+    assert len(ids) < len(data)

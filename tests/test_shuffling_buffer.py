@@ -1,0 +1,145 @@
+"""Unit tests for shuffling buffers (reference analogue:
+``petastorm/tests/test_shuffling_buffer.py``)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.readers.shuffling_buffer import (
+    BatchedNoopShufflingBuffer, BatchedRandomShufflingBuffer,
+    NoopShufflingBuffer, RandomShufflingBuffer)
+
+
+class TestNoopBuffer:
+    def test_fifo(self):
+        b = NoopShufflingBuffer()
+        b.add_many([1, 2, 3])
+        assert b.size == 3
+        assert [b.retrieve() for _ in range(3)] == [1, 2, 3]
+        assert not b.can_retrieve()
+
+    def test_finish_stops_adding(self):
+        b = NoopShufflingBuffer()
+        b.finish()
+        assert not b.can_add()
+
+
+class TestRandomBuffer:
+    def test_yields_all_items_exactly_once(self):
+        b = RandomShufflingBuffer(10, min_after_retrieve=3, seed=0)
+        out = []
+        it = iter(range(100))
+        exhausted = False
+        while True:
+            while b.can_add() and not exhausted:
+                try:
+                    b.add_many([next(it)])
+                except StopIteration:
+                    exhausted = True
+                    b.finish()
+            if not b.can_retrieve():
+                break
+            out.append(b.retrieve())
+        assert sorted(out) == list(range(100))
+
+    def test_actually_shuffles(self):
+        b = RandomShufflingBuffer(50, min_after_retrieve=30, seed=7)
+        out = []
+        stream = list(range(200))
+        i = 0
+        while i < len(stream) or b.can_retrieve():
+            while b.can_add() and i < len(stream):
+                b.add_many([stream[i]])
+                i += 1
+            if i == len(stream):
+                b.finish()
+            if b.can_retrieve():
+                out.append(b.retrieve())
+        assert sorted(out) == stream
+        assert out != stream  # vanishing probability of identity
+
+    def test_min_after_retrieve_respected(self):
+        b = RandomShufflingBuffer(10, min_after_retrieve=5)
+        b.add_many(list(range(5)))
+        assert b.can_retrieve()
+        b.retrieve()
+        assert not b.can_retrieve()  # 4 < 5 and not finished
+        b.finish()
+        assert b.can_retrieve()
+
+    def test_add_over_capacity_raises(self):
+        b = RandomShufflingBuffer(2, min_after_retrieve=1)
+        b.add_many([1, 2, 3])  # single overshoot allowed
+        assert not b.can_add()
+        with pytest.raises(RuntimeError):
+            b.add_many([4])
+
+
+class TestBatchedNoopBuffer:
+    def test_rechunks_in_order(self):
+        b = BatchedNoopShufflingBuffer(batch_size=4)
+        b.add_many({'x': np.arange(3), 'y': np.arange(3) * 10})
+        b.add_many({'x': np.arange(3, 9), 'y': np.arange(3, 9) * 10})
+        assert b.size == 9
+        out = b.retrieve()
+        np.testing.assert_array_equal(out['x'], [0, 1, 2, 3])
+        np.testing.assert_array_equal(out['y'], [0, 10, 20, 30])
+        b.finish()
+        out2 = b.retrieve()
+        np.testing.assert_array_equal(out2['x'], [4, 5, 6, 7])
+        out3 = b.retrieve()
+        np.testing.assert_array_equal(out3['x'], [8])
+        assert not b.can_retrieve()
+
+    def test_empty_chunk_ignored(self):
+        b = BatchedNoopShufflingBuffer(batch_size=2)
+        b.add_many({'x': np.array([], dtype=np.int64)})
+        assert b.size == 0
+
+
+class TestBatchedRandomBuffer:
+    def test_yields_every_row_once(self):
+        b = BatchedRandomShufflingBuffer(64, min_after_retrieve=16, batch_size=8, seed=3)
+        seen = []
+        for start in range(0, 128, 16):
+            while not b.can_add():
+                seen.extend(b.retrieve()['x'])
+            b.add_many({'x': np.arange(start, start + 16)})
+            while b.can_retrieve():
+                seen.extend(b.retrieve()['x'])
+        b.finish()
+        while b.can_retrieve():
+            seen.extend(b.retrieve()['x'])
+        assert sorted(seen) == list(range(128))
+
+    def test_shuffles_multicolumn_consistently(self):
+        b = BatchedRandomShufflingBuffer(100, min_after_retrieve=10, batch_size=10, seed=1)
+        b.add_many({'x': np.arange(50), 'y': np.arange(50) * 2})
+        xs, ys = [], []
+        b.finish()
+        while b.can_retrieve():
+            batch = b.retrieve()
+            xs.extend(batch['x'])
+            ys.extend(batch['y'])
+        np.testing.assert_array_equal(np.asarray(ys), np.asarray(xs) * 2)
+        assert xs != sorted(xs)
+
+    def test_overshoot_spill(self):
+        b = BatchedRandomShufflingBuffer(8, min_after_retrieve=1, batch_size=4, seed=0)
+        b.add_many({'x': np.arange(12)})  # 4 rows spill beyond capacity
+        assert b.size == 12
+        assert not b.can_add()
+        b.finish()
+        seen = []
+        while b.can_retrieve():
+            seen.extend(b.retrieve()['x'])
+        assert sorted(seen) == list(range(12))
+
+    def test_ndim_columns(self):
+        b = BatchedRandomShufflingBuffer(16, min_after_retrieve=1, batch_size=4, seed=0)
+        imgs = np.arange(8 * 2 * 2).reshape(8, 2, 2)
+        b.add_many({'img': imgs, 'id': np.arange(8)})
+        b.finish()
+        while b.can_retrieve():
+            batch = b.retrieve()
+            for img, i in zip(batch['img'], batch['id']):
+                np.testing.assert_array_equal(img, imgs[i])
