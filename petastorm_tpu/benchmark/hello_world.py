@@ -1,0 +1,42 @@
+"""Hello-world dataset generator: the workload behind the reference's headline
+throughput number (709.84 samples/sec, ``docs/benchmarks_tutorial.rst:20-21``).
+
+Schema mirrors ``examples/hello_world/petastorm_dataset/generate_petastorm_dataset.py:29-33``:
+an int id, a (128, 256, 3) png-compressed image, and a wildcard-shaped uint8
+4-d array — written here with the pyarrow-native writer instead of Spark.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+HelloWorldSchema = Unischema('HelloWorldSchema', [
+    UnischemaField('id', np.int32, (), ScalarCodec(), False),
+    UnischemaField('image1', np.uint8, (128, 256, 3), CompressedImageCodec('png'), False),
+    UnischemaField('array_4d', np.uint8, (None, 128, 30, None), NdarrayCodec(), False),
+])
+
+
+def row_generator(x: int) -> dict:
+    rng = np.random.default_rng(x)
+    return {'id': np.int32(x),
+            'image1': rng.integers(0, 255, dtype=np.uint8, size=(128, 256, 3)),
+            'array_4d': rng.integers(0, 255, dtype=np.uint8, size=(4, 128, 30, 3))}
+
+
+def generate_hello_world_dataset(output_url: str = 'file:///tmp/hello_world_dataset',
+                                 rows_count: int = 10) -> str:
+    with materialize_dataset(output_url, HelloWorldSchema,
+                             row_group_size_mb=256) as writer:
+        writer.write_rows(row_generator(i) for i in range(rows_count))
+    return output_url
+
+
+if __name__ == '__main__':
+    import sys
+    url = sys.argv[1] if len(sys.argv) > 1 else 'file:///tmp/hello_world_dataset'
+    print(generate_hello_world_dataset(url))

@@ -258,11 +258,11 @@ def materialize_dataset(dataset_url: str, schema: Unischema,
 
 
 def _list_data_files(filesystem, dataset_path) -> List[str]:
-    """Data files of a dataset directory, or the explicit file list as-is
-    (make_batch_reader accepts a list of parquet file urls,
-    reference ``reader.py:52-58``)."""
+    """Data files of a dataset directory, or the explicit file list in the
+    caller's order (make_batch_reader accepts a list of parquet file urls,
+    reference ``reader.py:52-58``; the user's ordering is part of the API)."""
     if isinstance(dataset_path, list):
-        return sorted(dataset_path)
+        return list(dataset_path)
     files = [f for f in filesystem.find(dataset_path) if _is_data_file(f)]
     return sorted(files)
 
@@ -314,7 +314,10 @@ def load_row_groups(filesystem, dataset_path: str,
             for rg in range(n):
                 pieces.append(RowGroupPiece(path=f, row_group=rg, num_rows=num_rows[rg],
                                             partition_values=parts))
-    pieces.sort(key=lambda p: (p.path, p.row_group))
+    if not is_file_list:
+        # Deterministic global ordering for directory datasets; explicit file
+        # lists keep the caller's order (executor.map preserves input order).
+        pieces.sort(key=lambda p: (p.path, p.row_group))
     return pieces
 
 

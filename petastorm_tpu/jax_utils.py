@@ -167,6 +167,9 @@ class JaxDataLoader(JaxLoaderBase):
             for batch in self._cache:
                 yield batch
             return
+        if self._cache is not None:
+            # A prior abandoned iteration may have left partial batches.
+            self._cache = []
         if self.reader.batched_output:
             gen = self._iter_batched()
         else:
@@ -341,21 +344,28 @@ def prefetch_to_device(iterator, size=2, sharding=None):
     state = {'error': None, 'finished': False}
 
     def put(batch):
+        # _is_device_compatible reads dtype via getattr: global jax.Arrays must
+        # NOT be round-tripped through np.asarray (device->host copy; crashes
+        # on non-fully-addressable multi-host arrays).
         if sharding is None:
             return jax.tree_util.tree_map(
-                lambda x: jax.device_put(x) if _is_device_compatible(np.asarray(x)) else x,
+                lambda x: jax.device_put(x) if _is_device_compatible(x) else x,
                 batch)
         return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, sharding) if _is_device_compatible(np.asarray(x)) else x,
+            lambda x: jax.device_put(x, sharding) if _is_device_compatible(x) else x,
             batch)
 
     def producer():
         try:
             for batch in iterator:
+                if state['finished']:   # consumer closed early: stop reading
+                    return
                 staged = put(batch)
                 with cv:
                     while len(queue) >= size and not state['finished']:
                         cv.wait()
+                    if state['finished']:
+                        return
                     queue.append(staged)
                     cv.notify_all()
         except Exception as e:  # propagate into the consumer

@@ -1,0 +1,16 @@
+"""Demonstration models fed by the petastorm_tpu data pipeline.
+
+The reference library ships no model code (SURVEY §0) — its examples train
+external TF/torch models from the reader. Here the example models are
+TPU-native JAX programs wired to the reader + JAX adapter, used by the
+benchmarks and the multi-chip dry run:
+
+- ``mnist_mlp`` — the hello-world slice (parquet → reader → jnp batches → MLP).
+- ``transformer_lm`` — flagship decoder-only LM with data/tensor/sequence/
+  expert parallel shardings over a named mesh; its token pipeline is the NGram
+  windowed reader.
+"""
+
+from petastorm_tpu.models import mnist_mlp, transformer_lm
+
+__all__ = ['mnist_mlp', 'transformer_lm']

@@ -1,0 +1,46 @@
+"""Minimal MLP classifier: the end-to-end "aha" slice of SURVEY §7.6
+(hello_world schema → parquet → make_reader → jnp batches → train step).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init(rng, input_dim: int = 784, hidden: int = 512, num_classes: int = 10,
+         dtype=jnp.float32):
+    k1, k2 = jax.random.split(rng)
+    scale1 = (2.0 / input_dim) ** 0.5
+    scale2 = (2.0 / hidden) ** 0.5
+    return {
+        'w1': (jax.random.normal(k1, (input_dim, hidden)) * scale1).astype(dtype),
+        'b1': jnp.zeros((hidden,), dtype),
+        'w2': (jax.random.normal(k2, (hidden, num_classes)) * scale2).astype(dtype),
+        'b2': jnp.zeros((num_classes,), dtype),
+    }
+
+
+def forward(params, images):
+    """images: (B, 784) float32 in [0, 1] → logits (B, 10)."""
+    h = jax.nn.relu(images @ params['w1'] + params['b1'])
+    return h @ params['w2'] + params['b2']
+
+
+def loss_fn(params, images, labels):
+    logits = forward(params, images)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1).squeeze(-1)
+    return jnp.mean(nll)
+
+
+@jax.jit
+def train_step(params, images, labels, lr=1e-3):
+    loss, grads = jax.value_and_grad(loss_fn)(params, images, labels)
+    params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    return params, loss
+
+
+@jax.jit
+def accuracy(params, images, labels):
+    return jnp.mean(jnp.argmax(forward(params, images), axis=-1) == labels)

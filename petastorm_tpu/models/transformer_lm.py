@@ -1,0 +1,316 @@
+"""Flagship decoder-only transformer LM, designed mesh-first.
+
+Parallelism is expressed entirely through GSPMD shardings over a named mesh
+(axes from ``petastorm_tpu.parallel.mesh``): annotate params/activations with
+PartitionSpecs, let XLA insert the collectives.
+
+- **dp** ('data'): batch dim of activations.
+- **tp** ('model'): Megatron-style column/row parallel attention + MLP —
+  wq/wk/wv and w_gate/w_up are column-parallel (output dim sharded), wo and
+  w_down row-parallel (input dim sharded); XLA inserts the psum where the
+  row-parallel matmul closes.
+- **sp** ('seq'): sequence dim of activations; attention runs as ring
+  attention (``petastorm_tpu/parallel/ring.py``) under shard_map so k/v chunks
+  rotate over ICI instead of being all-gathered.
+- **ep** ('expert'): optional MoE FFN with experts sharded one-per-group over
+  the expert axis.
+
+Compute dtype is bfloat16 (MXU-native); params and softmax/statistics stay
+float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from petastorm_tpu.ops.attention import blockwise_attention, flash_attention
+from petastorm_tpu.parallel.ring import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    n_experts: int = 0            # 0 → dense FFN; >0 → top-1 MoE
+    dtype: Any = jnp.bfloat16
+    # 'ring' shards attention over the 'seq' mesh axis; 'flash'/'blockwise'
+    # compute full attention locally (XLA all-gathers kv if seq is sharded).
+    attention: str = 'blockwise'
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init(rng, config: TransformerConfig) -> Dict:
+    """Initialize parameters as a pytree of float32 arrays."""
+    def dense(key, fan_in, shape):
+        return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+
+    keys = jax.random.split(rng, 4 + config.n_layers)
+    c = config
+    params = {
+        'embed': dense(keys[0], 1, (c.vocab_size, c.d_model)) * 0.02,
+        'final_norm': jnp.ones((c.d_model,), jnp.float32),
+        'unembed': dense(keys[1], c.d_model, (c.d_model, c.vocab_size)),
+        'layers': [],
+    }
+    for i in range(c.n_layers):
+        lk = jax.random.split(keys[4 + i], 8)
+        layer = {
+            'ln1': jnp.ones((c.d_model,), jnp.float32),
+            'wq': dense(lk[0], c.d_model, (c.d_model, c.d_model)),
+            'wk': dense(lk[1], c.d_model, (c.d_model, c.d_model)),
+            'wv': dense(lk[2], c.d_model, (c.d_model, c.d_model)),
+            'wo': dense(lk[3], c.d_model, (c.d_model, c.d_model)),
+            'ln2': jnp.ones((c.d_model,), jnp.float32),
+        }
+        if c.n_experts > 0:
+            layer.update({
+                'gate': dense(lk[7], c.d_model, (c.d_model, c.n_experts)),
+                'w_up': dense(lk[4], c.d_model, (c.n_experts, c.d_model, c.d_ff)),
+                'w_gate': dense(lk[5], c.d_model, (c.n_experts, c.d_model, c.d_ff)),
+                'w_down': dense(lk[6], c.d_ff, (c.n_experts, c.d_ff, c.d_model)),
+            })
+        else:
+            layer.update({
+                'w_up': dense(lk[4], c.d_model, (c.d_model, c.d_ff)),
+                'w_gate': dense(lk[5], c.d_model, (c.d_model, c.d_ff)),
+                'w_down': dense(lk[6], c.d_ff, (c.d_ff, c.d_model)),
+            })
+        params['layers'].append(layer)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# sharding rules
+# ---------------------------------------------------------------------------
+
+def param_specs(config: TransformerConfig, mesh) -> Dict:
+    """PartitionSpec pytree matching :func:`init`'s structure, using only axes
+    present in ``mesh`` (absent axes collapse to replication)."""
+    from jax.sharding import PartitionSpec as P
+
+    names = set(mesh.axis_names)
+    tp = 'model' if 'model' in names else None
+    ep = 'expert' if 'expert' in names else None
+
+    layer = {
+        'ln1': P(), 'ln2': P(),
+        'wq': P(None, tp), 'wk': P(None, tp), 'wv': P(None, tp),
+        'wo': P(tp, None),
+    }
+    if config.n_experts > 0:
+        layer.update({
+            'gate': P(),
+            'w_up': P(ep, None, tp), 'w_gate': P(ep, None, tp),
+            'w_down': P(ep, tp, None),
+        })
+    else:
+        layer.update({
+            'w_up': P(None, tp), 'w_gate': P(None, tp), 'w_down': P(tp, None),
+        })
+    return {
+        'embed': P(None, tp),
+        'final_norm': P(),
+        'unembed': P(None, tp),
+        'layers': [dict(layer) for _ in range(config.n_layers)],
+    }
+
+
+def batch_spec(mesh):
+    """Spec for a (batch, seq) token array over whatever of data/seq exists."""
+    from jax.sharding import PartitionSpec as P
+    names = set(mesh.axis_names)
+    return P('data' if 'data' in names else None,
+             'seq' if 'seq' in names else None)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _rms_norm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+
+
+def _rope(x, positions):
+    """Rotary position embedding. x: (B, H, L, D), positions: (L,) or (B, L)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(-jnp.arange(0, half, dtype=jnp.float32)
+                    * (math.log(10000.0) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs   # (..., L, half)
+    if angles.ndim == 2:            # (L, half) -> broadcast over B, H
+        angles = angles[None, None]
+    else:                           # (B, L, half) -> broadcast over H
+        angles = angles[:, None]
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _ring_attention_sharded(q, k, v, mesh):
+    """Ring attention under shard_map: q/k/v are global (B, H, L, dh) arrays
+    with L sharded over 'seq' (and B over 'data', H over 'model' when those
+    axes exist); each device folds rotating kv chunks over ICI."""
+    from jax.sharding import PartitionSpec as P
+
+    names = set(mesh.axis_names)
+    spec = P('data' if 'data' in names else None,
+             'model' if 'model' in names else None,
+             'seq', None)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec)
+    def fn(q, k, v):
+        return ring_attention(q, k, v, 'seq', causal=True)
+
+    return fn(q, k, v)
+
+
+def _attention(x, layer, config: TransformerConfig, positions, mesh=None):
+    c = config
+    b, l, _ = x.shape
+    h, dh = c.n_heads, c.head_dim
+
+    def heads(w):
+        y = (x @ w.astype(x.dtype)).reshape(b, l, h, dh)
+        return jnp.transpose(y, (0, 2, 1, 3))        # (B, H, L, dh)
+
+    q, k, v = heads(layer['wq']), heads(layer['wk']), heads(layer['wv'])
+    q, k = _rope(q, positions), _rope(k, positions)
+
+    if c.attention == 'ring':
+        if mesh is None or 'seq' not in mesh.axis_names:
+            raise ValueError("attention='ring' needs a mesh with a 'seq' axis")
+        o = _ring_attention_sharded(q, k, v, mesh)
+    elif c.attention == 'flash':
+        o = flash_attention(q, k, v, causal=True)
+    else:
+        o = blockwise_attention(q, k, v, causal=True)
+    o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, l, h * dh)
+    return o @ layer['wo'].astype(x.dtype)
+
+
+def _dense_ffn(x, layer):
+    gate = jax.nn.silu(x @ layer['w_gate'].astype(x.dtype))
+    up = x @ layer['w_up'].astype(x.dtype)
+    return (gate * up) @ layer['w_down'].astype(x.dtype)
+
+
+def _moe_ffn(x, layer, config: TransformerConfig):
+    """Top-1 MoE with dense one-hot dispatch: simple, fully shardable on the
+    'expert' axis (dispatch einsums contract over the expert dim, so XLA turns
+    them into all-to-all/psum over 'expert')."""
+    b, l, d = x.shape
+    logits = x.astype(jnp.float32) @ layer['gate']          # (B, L, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(probs, axis=-1)                        # (B, L)
+    onehot = jax.nn.one_hot(top, config.n_experts, dtype=x.dtype)  # (B, L, E)
+    scale = jnp.take_along_axis(probs, top[..., None], axis=-1).astype(x.dtype)
+
+    # dispatch: (E, B, L, d) rows routed to their expert, zeros elsewhere
+    xe = jnp.einsum('bld,ble->ebld', x, onehot)
+    gate = jax.nn.silu(jnp.einsum('ebld,edf->eblf', xe,
+                                  layer['w_gate'].astype(x.dtype)))
+    up = jnp.einsum('ebld,edf->eblf', xe, layer['w_up'].astype(x.dtype))
+    down = jnp.einsum('eblf,efd->ebld', gate * up,
+                      layer['w_down'].astype(x.dtype))
+    combined = jnp.einsum('ebld,ble->bld', down, onehot)
+    return combined * scale
+
+
+def forward(params, tokens, config: TransformerConfig,
+            positions: Optional[jnp.ndarray] = None, mesh=None):
+    """tokens (B, L) int32 → logits (B, L, vocab) float32."""
+    c = config
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1])
+    x = params['embed'].astype(c.dtype)[tokens]              # (B, L, D)
+    for layer in params['layers']:
+        h = _rms_norm(x, layer['ln1'])
+        x = x + _attention(h, layer, c, positions, mesh)
+        h = _rms_norm(x, layer['ln2'])
+        if c.n_experts > 0:
+            x = x + _moe_ffn(h, layer, c)
+        else:
+            x = x + _dense_ffn(h, layer)
+    x = _rms_norm(x, params['final_norm'])
+    return (x @ params['unembed'].astype(c.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, targets, config: TransformerConfig, mesh=None):
+    """Next-token cross entropy; ``targets`` are tokens shifted by the caller
+    (the NGram pipeline emits aligned (input, target) windows)."""
+    logits = forward(params, tokens, config, mesh=mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return jnp.mean(nll)
+
+
+# ---------------------------------------------------------------------------
+# training
+# ---------------------------------------------------------------------------
+
+def make_train_step(config: TransformerConfig, mesh=None, optimizer=None):
+    """Build a jitted ``(params, opt_state, tokens, targets) -> (params,
+    opt_state, loss)`` step.
+
+    With ``mesh``, params/activations are constrained to :func:`param_specs` /
+    :func:`batch_spec` shardings (dp/tp/sp/ep as present in the mesh); ring
+    attention additionally runs under shard_map on the 'seq' axis.
+    """
+    import optax
+    if optimizer is None:
+        optimizer = optax.adamw(3e-4, weight_decay=0.01)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets,
+                                                  config, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    if mesh is None:
+        return optimizer, jax.jit(step)
+
+    from jax.sharding import NamedSharding
+
+    pspecs = param_specs(config, mesh)
+    bspec = batch_spec(mesh)
+    p_shard = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs,
+                                     is_leaf=lambda x: isinstance(
+                                         x, type(bspec)))
+    b_shard = NamedSharding(mesh, bspec)
+    jitted = jax.jit(step,
+                     in_shardings=(p_shard, None, b_shard, b_shard),
+                     out_shardings=(p_shard, None, None))
+    return optimizer, jitted
+
+
+def make_forward(config: TransformerConfig):
+    """Jittable inference fn + tiny example args (single-chip compile check)."""
+    cfg = config
+
+    @jax.jit
+    def fn(params, tokens):
+        return forward(params, tokens, cfg)
+
+    return fn

@@ -1,0 +1,7 @@
+"""TPU compute kernels: Pallas implementations for the hot ops with pure-jnp
+fallbacks that run anywhere (CPU meshes, interpret mode)."""
+
+from petastorm_tpu.ops.attention import blockwise_attention, flash_attention
+from petastorm_tpu.ops.normalize import normalize_images
+
+__all__ = ['flash_attention', 'blockwise_attention', 'normalize_images']

@@ -1,0 +1,227 @@
+"""Attention kernels.
+
+``flash_attention`` dispatches to a Pallas TPU kernel (online-softmax, never
+materializes the (L, L) score matrix in HBM) and falls back to a
+``lax.scan``-based blockwise jnp implementation on other backends. Both share
+the same math, so tests can assert the Pallas path against the fallback.
+
+The blockwise core is also the per-step building block of ring attention
+(``petastorm_tpu/parallel/ring.py``): one (q-chunk, kv-chunk) partial update of
+the running (o, m, l) accumulators.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# blockwise jnp core
+# ---------------------------------------------------------------------------
+
+def _block_update(q, k, v, o, m, l, scale, mask):
+    """One online-softmax update: attend q against (k, v) and fold into the
+    running (o, m, l) accumulators. Shapes: q (..., Lq, D), k/v (..., Lk, D),
+    o (..., Lq, D), m/l (..., Lq)."""
+    s = jnp.einsum('...qd,...kd->...qk', q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # guard fully-masked rows: exp(-inf - (-inf)) -> exp(0); zero them via l
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    o_new = o * correction[..., None] + jnp.einsum('...qk,...kd->...qd', p, v)
+    return o_new, m_new, l_new
+
+
+def attention_accumulators(q_len: int, head_dim: int, batch_shape=()):
+    """Fresh (o, m, l) accumulators for online-softmax accumulation."""
+    o = jnp.zeros(batch_shape + (q_len, head_dim), dtype=jnp.float32)
+    m = jnp.full(batch_shape + (q_len,), _NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros(batch_shape + (q_len,), dtype=jnp.float32)
+    return o, m, l
+
+
+def finalize_attention(o, l):
+    """Normalize accumulated output; fully-masked rows yield zeros."""
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return o / safe_l[..., None]
+
+
+def attention_block_step(q, k, v, o, m, l, *, scale=None,
+                         q_positions=None, k_positions=None, causal=True):
+    """Public building block used by ring attention: fold one kv chunk into the
+    accumulators, masking by absolute token positions when ``causal``."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    mask = None
+    if causal:
+        if q_positions is None or k_positions is None:
+            raise ValueError('causal masking needs q_positions/k_positions')
+        mask = q_positions[..., :, None] >= k_positions[..., None, :]
+    return _block_update(q, k, v, o, m, l, scale, mask)
+
+
+def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512):
+    """Memory-efficient attention: scan over key/value blocks with online
+    softmax. Works on any backend; O(L·block_k) live memory per head.
+
+    Shapes: q/k/v ``(..., L, D)``; returns ``(..., L, D)`` in q's dtype.
+    """
+    orig_dtype = q.dtype
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    q_len, k_len = q.shape[-2], k.shape[-2]
+    batch_shape = q.shape[:-2]
+
+    pad = (-k_len) % block_k
+    if pad:
+        pad_width = [(0, 0)] * (k32.ndim - 2) + [(0, pad), (0, 0)]
+        k32 = jnp.pad(k32, pad_width)
+        v32 = jnp.pad(v32, pad_width)
+    padded_k_len = k_len + pad
+    num_blocks = padded_k_len // block_k
+
+    # (num_blocks, ..., block_k, D) for scanning
+    def to_blocks(x):
+        x = jnp.moveaxis(x, -2, 0)                     # (Lk, ..., D)
+        x = x.reshape((num_blocks, block_k) + x.shape[1:])
+        return jnp.moveaxis(x, 1, -2)                  # (nb, ..., block_k, D)
+
+    kb, vb = to_blocks(k32), to_blocks(v32)
+    q_pos = jnp.arange(q_len)
+    o, m, l = attention_accumulators(q_len, q.shape[-1], batch_shape)
+
+    def step(carry, inputs):
+        o, m, l = carry
+        k_blk, v_blk, blk_idx = inputs
+        k_pos = blk_idx * block_k + jnp.arange(block_k)
+        valid = k_pos < k_len                           # mask tail padding
+        if causal:
+            mask = (q_pos[:, None] >= k_pos[None, :]) & valid[None, :]
+        else:
+            mask = jnp.broadcast_to(valid[None, :], (q_len, block_k))
+        o, m, l = _block_update(q32, k_blk, v_blk, o, m, l, scale, mask)
+        return (o, m, l), None
+
+    (o, m, l), _ = jax.lax.scan(step, (o, m, l),
+                                (kb, vb, jnp.arange(num_blocks)))
+    return finalize_attention(o, l).astype(orig_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float, q_seq_len: int, kv_seq_len: int, block_q: int):
+    """One (batch·head, q-block) program: scan kv blocks held in VMEM.
+
+    Block shapes: q_ref (block_q, D), k_ref/v_ref (kv_seq_len, D) — the kernel
+    slices kv blocks itself so the MXU sees (block_q, D) x (D, block_k) matmuls.
+    """
+    from jax.experimental import pallas as pl
+
+    q_blk_idx = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32)
+    q_pos = q_blk_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0).squeeze(-1)
+
+    num_kv_blocks = kv_seq_len // block_k
+
+    def body(kv_idx, carry):
+        o, m, l = carry
+        k = k_ref[pl.ds(kv_idx * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kv_idx * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1).squeeze(0)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full((block_q,), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
+
+    if causal:
+        # Skip kv blocks strictly above the causal diagonal for this q block.
+        upper = jax.lax.div(
+            (q_blk_idx + 1) * block_q + block_k - 1, block_k)
+        upper = jnp.minimum(upper, num_kv_blocks)
+    else:
+        upper = num_kv_blocks
+    o, m, l = jax.lax.fori_loop(0, upper, body, (o0, m0, l0))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[...] = (o / safe_l[:, None]).astype(o_ref.dtype)
+
+
+def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
+                  interpret: bool = False):
+    from jax.experimental import pallas as pl
+
+    *batch, q_len, head_dim = q.shape
+    kv_len = k.shape[-2]
+    bq = min(block_q, q_len)
+    bk = min(block_k, kv_len)
+    if q_len % bq or kv_len % bk:
+        raise ValueError('sequence lengths must be divisible by block sizes '
+                         '(q: {} % {}, kv: {} % {})'.format(q_len, bq, kv_len, bk))
+    flat = int(jnp.prod(jnp.asarray(batch))) if batch else 1
+    qf = q.reshape(flat, q_len, head_dim)
+    kf = k.reshape(flat, kv_len, head_dim)
+    vf = v.reshape(flat, kv_len, head_dim)
+    scale = 1.0 / math.sqrt(head_dim)
+
+    kernel = functools.partial(_flash_kernel, block_k=bk, causal=causal,
+                               scale=scale, q_seq_len=q_len, kv_seq_len=kv_len,
+                               block_q=bq)
+    out = pl.pallas_call(
+        kernel,
+        grid=(flat, q_len // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, kv_len, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, kv_len, head_dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, head_dim), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((flat, q_len, head_dim), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(q.shape)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
+                    block_k: int = 512, backend: Optional[str] = None):
+    """Fused attention over ``(..., L, D)`` inputs.
+
+    ``backend``: 'pallas' forces the TPU kernel, 'jnp' the scan fallback,
+    'interpret' the Pallas interpreter (CI on CPU); default picks Pallas on TPU.
+    """
+    if backend is None:
+        backend = 'pallas' if jax.default_backend() == 'tpu' else 'jnp'
+    if backend == 'pallas':
+        return _pallas_flash(q, k, v, causal, block_q, block_k)
+    if backend == 'interpret':
+        return _pallas_flash(q, k, v, causal, block_q, block_k, interpret=True)
+    return blockwise_attention(q, k, v, causal=causal, block_k=block_k)

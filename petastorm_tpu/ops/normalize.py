@@ -1,0 +1,59 @@
+"""Fused image normalization: uint8 HWC batches → scaled bfloat16/float32.
+
+The classic first op of every vision input pipeline ((x/255 - mean) / std).
+Doing it on device right after infeed keeps the host→HBM transfer at 1
+byte/pixel (uint8) instead of 4 (float32) — a 4× infeed bandwidth win, which is
+exactly the bottleneck the reference's CPU-side decode pipeline fights.
+
+Pallas kernel on TPU (single fused VPU pass), jnp elsewhere (XLA fuses it too;
+the kernel exists to guarantee the fusion and to skip the f32 intermediate).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _normalize_kernel(x_ref, mean_ref, inv_std_ref, o_ref):
+    x = x_ref[...].astype(jnp.float32) * (1.0 / 255.0)
+    o_ref[...] = ((x - mean_ref[...]) * inv_std_ref[...]).astype(o_ref.dtype)
+
+
+def normalize_images(images, mean=(0.485, 0.456, 0.406),
+                     std=(0.229, 0.224, 0.225), dtype=jnp.bfloat16,
+                     backend=None):
+    """Normalize a uint8 image batch ``(N, H, W, C)`` to ``dtype``.
+
+    ``backend``: 'pallas' | 'jnp' | 'interpret'; default picks pallas on TPU.
+    """
+    if backend is None:
+        backend = 'pallas' if jax.default_backend() == 'tpu' else 'jnp'
+    mean_arr = jnp.asarray(mean, dtype=jnp.float32)
+    inv_std = 1.0 / jnp.asarray(std, dtype=jnp.float32)
+    if backend == 'jnp':
+        x = images.astype(jnp.float32) / 255.0
+        return ((x - mean_arr) * inv_std).astype(dtype)
+
+    from jax.experimental import pallas as pl
+
+    n, h, w, c = images.shape
+    flat = images.reshape(n, h * w * c)
+    mean_row = jnp.tile(mean_arr, h * w)
+    inv_row = jnp.tile(inv_std, h * w)
+    out = pl.pallas_call(
+        _normalize_kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((None, h * w * c), lambda i: (i, 0)),
+            pl.BlockSpec((h * w * c,), lambda i: (0,)),
+            pl.BlockSpec((h * w * c,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((None, h * w * c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h * w * c), dtype),
+        interpret=(backend == 'interpret'),
+    )(flat, mean_row, inv_row)
+    return out.reshape(n, h, w, c)

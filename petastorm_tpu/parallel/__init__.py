@@ -1,0 +1,14 @@
+"""Distributed/parallel primitives for TPU meshes.
+
+The reference's only multi-node story is static shard arithmetic + Horovod env
+vars (``spark_dataset_converter.py:122-159``); here the distributed layer is
+first-class: mesh construction, partition specs, per-host data sharding, and
+ring-based sequence parallelism over XLA collectives (ICI/DCN).
+"""
+
+from petastorm_tpu.parallel.mesh import (batch_sharding, host_shard,
+                                         make_mesh, replicated_sharding)
+from petastorm_tpu.parallel.ring import ring_attention
+
+__all__ = ['make_mesh', 'host_shard', 'batch_sharding', 'replicated_sharding',
+           'ring_attention']

@@ -1,0 +1,88 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Each device holds one sequence chunk of q/k/v. K/V chunks rotate around the
+mesh axis with ``jax.lax.ppermute`` (XLA lowers this to ICI neighbor sends)
+while every device folds each visiting chunk into its online-softmax
+accumulators — compute on chunk j overlaps the transfer of chunk j+1, so the
+ring latency hides behind the attention FLOPs. Memory per device stays
+O(L_local²-free): only (o, m, l) accumulators and one in-flight kv chunk.
+
+This is the long-context/sequence-parallel capability the data-side NGram
+assembler (``petastorm_tpu/ngram.py``) feeds; model-side it composes with data
+and tensor parallelism over the same mesh (axes 'data'/'seq'/'model').
+
+Use inside ``jax.shard_map`` with q/k/v partitioned over ``axis_name`` on the
+sequence dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from petastorm_tpu.ops.attention import attention_block_step, finalize_attention
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = True):
+    """Exact (optionally causal) attention over a ring-sharded sequence.
+
+    Args:
+        q, k, v: local chunks ``(..., L_local, D)``; the global sequence is the
+            concatenation of chunks in mesh-axis order.
+        axis_name: mesh axis the sequence is sharded over.
+        causal: mask by *global* token positions.
+
+    Returns the local output chunk ``(..., L_local, D)`` in q's dtype.
+    """
+    orig_dtype = q.dtype
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    l_local = q.shape[-2]
+
+    q_pos = my_idx * l_local + jnp.arange(l_local)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, ring_step):
+        k_cur, v_cur, o, m, l = carry
+        src_idx = (my_idx - ring_step) % n       # whose chunk we hold this step
+        k_pos = src_idx * l_local + jnp.arange(l_local)
+        o, m, l = attention_block_step(
+            q32, k_cur, v_cur, o, m, l,
+            q_positions=q_pos, k_positions=k_pos, causal=causal)
+        # Rotate kv to the next device; XLA overlaps this with the next
+        # iteration's compute when possible.
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, o, m, l), None
+
+    # Derive accumulators from q32 so they carry the same shard_map
+    # varying-axes type as the rotating kv chunks (scan carry typing).
+    o0 = jnp.zeros_like(q32)
+    m0 = jnp.full_like(q32[..., 0], -1e30)
+    l0 = jnp.zeros_like(q32[..., 0])
+    (k_fin, v_fin, o, m, l), _ = jax.lax.scan(
+        step, (k32, v32, o0, m0, l0), jnp.arange(n))
+    return finalize_attention(o, l).astype(orig_dtype)
+
+
+def make_ring_attention(mesh, seq_axis: str = 'seq', causal: bool = True):
+    """Wrap :func:`ring_attention` in a ``shard_map`` over ``mesh``.
+
+    Returns ``fn(q, k, v) -> out`` for global arrays of shape
+    ``(batch, heads, L, D)`` with L sharded over ``seq_axis`` and batch over
+    'data' when present in the mesh.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    batch_axis = 'data' if 'data' in mesh.axis_names else None
+    spec = P(batch_axis, None, seq_axis, None)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    def fn(q, k, v):
+        return ring_attention(q, k, v, seq_axis, causal=causal)
+
+    return fn

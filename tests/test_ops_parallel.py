@@ -1,0 +1,143 @@
+"""Tests for compute kernels (ops/) and parallel primitives (parallel/):
+blockwise + pallas-interpret flash attention vs a dense softmax reference,
+ring attention over a multi-device mesh, mesh helpers, image normalization.
+
+Everything is pinned to CPU devices explicitly — the session may have a TPU
+attached, and these are exactness tests (MXU default precision would blur them).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+jax.config.update('jax_default_matmul_precision', 'highest')
+
+
+@pytest.fixture(scope='module')
+def cpus():
+    devices = jax.devices('cpu')
+    if len(devices) < 8:
+        pytest.skip('needs 8 CPU devices (XLA_FLAGS=--xla_force_host_platform_device_count=8)')
+    return devices
+
+
+def _ref_attention(q, k, v, causal=True):
+    d = q.shape[-1]
+    s = jnp.einsum('...qd,...kd->...qk', q, k) / np.sqrt(d)
+    if causal:
+        l_q, l_k = q.shape[-2], k.shape[-2]
+        mask = np.tril(np.ones((l_q, l_k), bool))
+        s = jnp.where(mask, s, -1e30)
+    return jnp.einsum('...qk,...kd->...qd', jax.nn.softmax(s, axis=-1), v)
+
+
+@pytest.fixture(scope='module')
+def qkv(cpus):
+    rng = np.random.default_rng(0)
+    with jax.default_device(cpus[0]):
+        return tuple(jnp.asarray(rng.standard_normal((2, 4, 128, 32)),
+                                 dtype=jnp.float32) for _ in range(3))
+
+
+class TestBlockwiseAttention:
+    @pytest.mark.parametrize('causal', [True, False])
+    @pytest.mark.parametrize('block_k', [32, 128, 100])  # incl. non-divisor
+    def test_matches_reference(self, qkv, cpus, causal, block_k):
+        from petastorm_tpu.ops.attention import blockwise_attention
+        q, k, v = qkv
+        with jax.default_device(cpus[0]):
+            out = blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+            ref = _ref_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_cross_attention_shapes(self, cpus):
+        from petastorm_tpu.ops.attention import blockwise_attention
+        rng = np.random.default_rng(1)
+        with jax.default_device(cpus[0]):
+            q = jnp.asarray(rng.standard_normal((1, 2, 16, 8)), jnp.float32)
+            k = jnp.asarray(rng.standard_normal((1, 2, 48, 8)), jnp.float32)
+            v = jnp.asarray(rng.standard_normal((1, 2, 48, 8)), jnp.float32)
+            out = blockwise_attention(q, k, v, causal=False, block_k=16)
+            ref = _ref_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+class TestPallasFlashInterpret:
+    @pytest.mark.parametrize('causal', [True, False])
+    def test_matches_reference(self, qkv, cpus, causal):
+        from petastorm_tpu.ops.attention import flash_attention
+        q, k, v = qkv
+        with jax.default_device(cpus[0]):
+            out = flash_attention(q, k, v, causal=causal, block_q=32,
+                                  block_k=32, backend='interpret')
+            ref = _ref_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_rejects_indivisible_blocks(self, cpus):
+        from petastorm_tpu.ops.attention import flash_attention
+        with jax.default_device(cpus[0]):
+            q = jnp.zeros((1, 1, 100, 16))
+            with pytest.raises(ValueError, match='divisible'):
+                flash_attention(q, q, q, block_q=64, block_k=64,
+                                backend='interpret')
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize('causal', [True, False])
+    def test_matches_reference(self, qkv, cpus, causal):
+        from petastorm_tpu.parallel import make_mesh
+        from petastorm_tpu.parallel.ring import make_ring_attention
+        q, k, v = qkv
+        mesh = make_mesh({'data': 2, 'seq': 4}, devices=cpus)
+        out = make_ring_attention(mesh, 'seq', causal=causal)(q, k, v)
+        with jax.default_device(cpus[0]):
+            ref = _ref_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_seq_only_mesh(self, qkv, cpus):
+        from petastorm_tpu.parallel import make_mesh
+        from petastorm_tpu.parallel.ring import make_ring_attention
+        q, k, v = qkv
+        mesh = make_mesh({'seq': 8}, devices=cpus)
+        out = make_ring_attention(mesh, 'seq')(q, k, v)
+        with jax.default_device(cpus[0]):
+            ref = _ref_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+class TestMesh:
+    def test_make_mesh_axes(self, cpus):
+        from petastorm_tpu.parallel import make_mesh
+        mesh = make_mesh({'data': 2, 'model': 4}, devices=cpus)
+        assert mesh.axis_names == ('data', 'model')
+        assert mesh.devices.shape == (2, 4)
+
+    def test_make_mesh_wrong_count(self, cpus):
+        from petastorm_tpu.parallel import make_mesh
+        with pytest.raises(ValueError, match='require'):
+            make_mesh({'data': 3}, devices=cpus)
+
+    def test_batch_sharding(self, cpus):
+        from petastorm_tpu.parallel import batch_sharding, make_mesh
+        mesh = make_mesh({'data': 8}, devices=cpus)
+        arr = jax.device_put(np.zeros((16, 4)), batch_sharding(mesh))
+        assert len(arr.sharding.device_set) == 8
+
+
+class TestNormalize:
+    @pytest.mark.parametrize('backend', ['jnp', 'interpret'])
+    def test_matches_formula(self, cpus, backend):
+        from petastorm_tpu.ops.normalize import normalize_images
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 255, (4, 8, 8, 3), dtype=np.uint8)
+        with jax.default_device(cpus[0]):
+            out = normalize_images(jnp.asarray(imgs), dtype=jnp.float32,
+                                   backend=backend)
+        mean = np.array([0.485, 0.456, 0.406], np.float32)
+        std = np.array([0.229, 0.224, 0.225], np.float32)
+        ref = (imgs.astype(np.float32) / 255.0 - mean) / std
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
