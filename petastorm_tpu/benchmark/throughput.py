@@ -47,8 +47,7 @@ def reader_throughput(dataset_url: str,
                       shuffling_queue_size: int = 500,
                       read_method: str = 'python',
                       batch_reader: bool = False,
-                      jax_batch_size: int = 0,
-                      spawn_new_process: bool = False) -> ThroughputResult:
+                      jax_batch_size: int = 0) -> ThroughputResult:
     """Measure reader throughput on ``dataset_url``.
 
     ``read_method='python'`` iterates raw reader rows/batches;

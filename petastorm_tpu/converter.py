@@ -1,0 +1,324 @@
+"""Dataset converter: materialize-and-cache in-memory data for training loops.
+
+Reference parity: ``petastorm/spark/spark_dataset_converter.py`` — but the
+input is a pyarrow Table or pandas DataFrame instead of a Spark DataFrame
+(a Spark DataFrame is accepted too when pyspark is importable: it is collected
+to arrow via ``toPandas``). Feature mapping:
+
+- parent cache dir conf (``:59-78``)        → ``set_parent_cache_dir_url`` /
+  ``PETASTORM_TPU_CACHE_DIR`` env var / explicit argument
+- query-plan cache key (``:476-512``)       → content fingerprint of the arrow
+  table (schema + row count + per-column chunk hashes) + params
+- precision normalization (``:524-544``)    → ``dtype_overrides`` / ``precision``
+- uncompressed default (``:685-691``)       → same
+- atexit best-effort delete (``:115-119``)  → same
+- rank/size sanity warning (``:122-159``)   → ``jax.process_index/count`` first,
+  then Horovod/MPI/PMI env vars
+- ``make_tf_dataset``/``make_torch_dataloader`` (``:198,:246``) → plus
+  ``make_jax_loader``
+"""
+
+from __future__ import annotations
+
+import atexit
+import hashlib
+import logging
+import os
+import threading
+import time
+import uuid
+import warnings
+from typing import Dict, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dir_url
+
+logger = logging.getLogger(__name__)
+
+_parent_cache_dir_url = None
+_cache_lock = threading.Lock()
+# cache key -> SavedDataset; mirrors the reference's driver-side registry
+_materialized: Dict[str, 'SavedDataset'] = {}
+
+
+def set_parent_cache_dir_url(url: Optional[str]) -> None:
+    """Set the parent directory under which converters materialize datasets
+    (reference conf key ``petastorm.spark.converter.parentCacheDirUrl``)."""
+    global _parent_cache_dir_url
+    _parent_cache_dir_url = normalize_dir_url(url) if url else None
+
+
+def _get_parent_cache_dir_url(explicit: Optional[str]) -> str:
+    if explicit:
+        return normalize_dir_url(explicit)
+    if _parent_cache_dir_url:
+        return _parent_cache_dir_url
+    env = os.environ.get('PETASTORM_TPU_CACHE_DIR')
+    if env:
+        return normalize_dir_url(env)
+    raise ValueError(
+        'No cache directory configured. Pass parent_cache_dir_url=, call '
+        'set_parent_cache_dir_url(), or set PETASTORM_TPU_CACHE_DIR')
+
+
+def _get_rank_and_size():
+    """(rank, size) of this training process: JAX process topology first, env
+    vars second (reference ``_get_horovod_rank_and_size``, ``:122-135``)."""
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return jax.process_index(), jax.process_count()
+    except Exception:  # jax absent or uninitialized distributed runtime
+        pass
+    for rank_env, size_env in [('HOROVOD_RANK', 'HOROVOD_SIZE'),
+                               ('OMPI_COMM_WORLD_RANK', 'OMPI_COMM_WORLD_SIZE'),
+                               ('PMI_RANK', 'PMI_SIZE')]:
+        rank, size = os.environ.get(rank_env), os.environ.get(size_env)
+        if rank is not None and size is not None:
+            return int(rank), int(size)
+    return None, None
+
+
+def _check_rank_mismatch(cur_shard, shard_count):
+    rank, size = _get_rank_and_size()
+    if rank is not None and (cur_shard != rank or shard_count != size):
+        warnings.warn('This process is rank {} of {} but cur_shard={} '
+                      'shard_count={} were requested; double-check your '
+                      'sharding arguments'.format(rank, size, cur_shard,
+                                                  shard_count))
+
+
+def _to_arrow_table(data) -> pa.Table:
+    if isinstance(data, pa.Table):
+        return data
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            return pa.Table.from_pandas(data, preserve_index=False)
+    except ImportError:
+        pass
+    # Spark DataFrame (optional interop; collected to the driver)
+    if hasattr(data, 'toPandas') and hasattr(data, 'schema'):
+        logger.info('Collecting Spark DataFrame to the driver for '
+                    'materialization')
+        return pa.Table.from_pandas(data.toPandas(), preserve_index=False)
+    raise TypeError('Unsupported input type {}; expected pyarrow.Table, '
+                    'pandas.DataFrame or pyspark DataFrame'.format(type(data)))
+
+
+def _normalize_precision(table: pa.Table, precision: Optional[str]) -> pa.Table:
+    """float64→float32 ('float32') or float32→float64 ('float64') column casts
+    (reference ``_convert_precision``, ``:524-544``)."""
+    if precision is None:
+        return table
+    if precision not in ('float32', 'float64'):
+        raise ValueError("precision must be 'float32', 'float64' or None")
+    src = pa.float64() if precision == 'float32' else pa.float32()
+    dst = pa.float32() if precision == 'float32' else pa.float64()
+    fields = []
+    changed = False
+    for f in table.schema:
+        if f.type == src:
+            fields.append(pa.field(f.name, dst, f.nullable))
+            changed = True
+        else:
+            fields.append(f)
+    return table.cast(pa.schema(fields)) if changed else table
+
+
+def _fingerprint(table: pa.Table, params: Dict) -> str:
+    """Content-addressed cache key: schema + shape + sampled column bytes +
+    materialization params."""
+    h = hashlib.sha256()
+    h.update(table.schema.to_string().encode())
+    h.update(str(table.num_rows).encode())
+    for name in table.column_names:
+        col = table.column(name)
+        for chunk in col.chunks[:4]:
+            head = chunk.slice(0, min(len(chunk), 1024))
+            for buf in head.buffers():
+                if buf is not None:
+                    h.update(bytes(buf)[:4096])
+    h.update(repr(sorted(params.items())).encode())
+    return h.hexdigest()[:32]
+
+
+class SavedDataset(object):
+    """Picklable handle to a materialized dataset (reference
+    ``SparkDatasetConverter``, ``:162-187``): workers/other processes can
+    unpickle it and open readers without re-materializing."""
+
+    def __init__(self, cache_dir_url: str, file_urls, dataset_size: int,
+                 parent_cache_dir_url: str):
+        self.cache_dir_url = cache_dir_url
+        self.file_urls = list(file_urls)
+        self.dataset_size = dataset_size
+        self._parent_cache_dir_url = parent_cache_dir_url
+
+    def __len__(self):
+        return self.dataset_size
+
+    # -- consumption ---------------------------------------------------------
+
+    def make_jax_loader(self, batch_size=32, mesh=None, num_epochs=None,
+                        shuffling_queue_capacity=0, reader_pool_type='thread',
+                        workers_count=4, cur_shard=None, shard_count=None,
+                        **reader_kwargs):
+        """Context manager yielding a :class:`JaxDataLoader` /
+        :class:`ShardedJaxLoader` over the materialized data."""
+        from petastorm_tpu.jax_utils import make_jax_loader
+        from petastorm_tpu.reader import make_batch_reader
+        if cur_shard is not None:
+            _check_rank_mismatch(cur_shard, shard_count)
+        reader = make_batch_reader(
+            self.file_urls, num_epochs=num_epochs,
+            reader_pool_type=reader_pool_type, workers_count=workers_count,
+            cur_shard=cur_shard, shard_count=shard_count, **reader_kwargs)
+        return make_jax_loader(reader, batch_size=batch_size, mesh=mesh,
+                               shuffling_queue_capacity=shuffling_queue_capacity)
+
+    def make_torch_dataloader(self, batch_size=32, num_epochs=None,
+                              shuffling_queue_capacity=0,
+                              reader_pool_type='thread', workers_count=4,
+                              cur_shard=None, shard_count=None,
+                              inmemory_cache_all=False, **reader_kwargs):
+        from petastorm_tpu.pytorch import BatchedDataLoader
+        from petastorm_tpu.reader import make_batch_reader
+        if cur_shard is not None:
+            _check_rank_mismatch(cur_shard, shard_count)
+        reader = make_batch_reader(
+            self.file_urls, num_epochs=num_epochs,
+            reader_pool_type=reader_pool_type, workers_count=workers_count,
+            cur_shard=cur_shard, shard_count=shard_count, **reader_kwargs)
+        return BatchedDataLoader(
+            reader, batch_size=batch_size,
+            shuffling_queue_capacity=shuffling_queue_capacity,
+            inmemory_cache_all=inmemory_cache_all)
+
+    def make_tf_dataset(self, batch_size=None, num_epochs=None,
+                        reader_pool_type='thread', workers_count=4,
+                        cur_shard=None, shard_count=None, **reader_kwargs):
+        from petastorm_tpu.reader import make_batch_reader
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+        if cur_shard is not None:
+            _check_rank_mismatch(cur_shard, shard_count)
+        reader = make_batch_reader(
+            self.file_urls, num_epochs=num_epochs,
+            reader_pool_type=reader_pool_type, workers_count=workers_count,
+            cur_shard=cur_shard, shard_count=shard_count, **reader_kwargs)
+        dataset = make_petastorm_dataset(reader)
+        if batch_size:
+            dataset = dataset.unbatch().batch(batch_size)
+        return _TfDatasetContextManager(reader, dataset)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def delete(self):
+        """Remove the materialized files (reference ``delete()``, ``:290-292``)."""
+        fs, path, _ = get_filesystem_and_path_or_paths(self.cache_dir_url)
+        try:
+            if fs.exists(path):
+                fs.rm(path, recursive=True)
+        except OSError as e:
+            logger.warning('Failed to delete %s: %s', self.cache_dir_url, e)
+        with _cache_lock:
+            for key, saved in list(_materialized.items()):
+                if saved is self or saved.cache_dir_url == self.cache_dir_url:
+                    del _materialized[key]
+
+
+class _TfDatasetContextManager(object):
+    def __init__(self, reader, dataset):
+        self._reader = reader
+        self.dataset = dataset
+
+    def __enter__(self):
+        return self.dataset
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self._reader.stop()
+        self._reader.join()
+
+
+def _wait_file_available(fs, paths, timeout_s: float = 30.0):
+    """Poll until all paths exist (eventually-consistent object stores;
+    reference ``_wait_file_available``, ``:592-621``)."""
+    deadline = time.monotonic() + timeout_s
+    pending = list(paths)
+    while pending:
+        pending = [p for p in pending if not fs.exists(p)]
+        if not pending:
+            return
+        if time.monotonic() > deadline:
+            raise RuntimeError('Timed out waiting for files: {}'.format(
+                pending[:3]))
+        time.sleep(0.1)
+
+
+_MEDIAN_SIZE_WARN_BYTES = 50 * 1024 * 1024
+
+
+def make_dataset_converter(data, parent_cache_dir_url: Optional[str] = None,
+                           precision: Optional[str] = None,
+                           compression: Optional[str] = None,
+                           row_group_size_mb: float = 32.0,
+                           delete_at_exit: bool = True) -> SavedDataset:
+    """Materialize ``data`` to parquet under the cache dir (or reuse an
+    existing materialization with identical content+params) and return a
+    picklable :class:`SavedDataset` handle (reference ``make_spark_converter``,
+    ``:646-706``)."""
+    table = _normalize_precision(_to_arrow_table(data), precision)
+    parent = _get_parent_cache_dir_url(parent_cache_dir_url)
+    params = {'compression': compression or 'none',
+              'row_group_size_mb': row_group_size_mb,
+              'precision': precision or 'none'}
+    key = _fingerprint(table, params)
+
+    with _cache_lock:
+        cached = _materialized.get(key)
+        if cached is not None:
+            fs, path, _ = get_filesystem_and_path_or_paths(cached.cache_dir_url)
+            if fs.exists(path):
+                logger.info('Cache hit: reusing %s', cached.cache_dir_url)
+                return cached
+            del _materialized[key]
+
+    # cache dir name mirrors the reference's '{time}-appid-{appid}-{uuid}'
+    dir_name = '{}-{}'.format(int(time.time()), uuid.uuid4().hex[:12])
+    cache_dir_url = '{}/{}'.format(parent.rstrip('/'), dir_name)
+    fs, path, _ = get_filesystem_and_path_or_paths(cache_dir_url)
+    fs.makedirs(path, exist_ok=True)
+
+    file_path = '{}/part_00000.parquet'.format(path)
+    row_group_rows = max(
+        1, int(row_group_size_mb * 1024 * 1024 /
+               max(1, table.nbytes // max(1, table.num_rows))))
+    with fs.open(file_path, 'wb') as f:
+        pq.write_table(table, f, row_group_size=row_group_rows,
+                       compression=compression or 'NONE')
+    _wait_file_available(fs, [file_path])
+
+    sizes = [fs.info(file_path)['size']]
+    if np.median(sizes) > 0 and np.median(sizes) < 1024 and table.num_rows > 100000:
+        warnings.warn('Materialized parquet files are very small; performance '
+                      'may suffer (reference recommends >=50MB median)')
+
+    scheme = cache_dir_url.split('://', 1)[0]
+    saved = SavedDataset(cache_dir_url,
+                         ['{}://{}'.format(scheme, file_path)],
+                         table.num_rows, parent)
+    with _cache_lock:
+        _materialized[key] = saved
+    if delete_at_exit:
+        atexit.register(_best_effort_delete, saved)
+    return saved
+
+
+def _best_effort_delete(saved: SavedDataset):
+    try:
+        saved.delete()
+    except Exception:  # noqa: BLE001 — atexit must never raise
+        pass

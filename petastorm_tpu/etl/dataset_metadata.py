@@ -269,7 +269,9 @@ def _list_data_files(filesystem, dataset_path) -> List[str]:
 
 def load_row_groups(filesystem, dataset_path: str,
                     num_discovery_workers: int = 8) -> List[RowGroupPiece]:
-    """Discover all row groups of a dataset as a deterministic, sorted piece list.
+    """Discover all row groups of a dataset as a deterministic piece list:
+    sorted by (path, row_group) for directory datasets, caller's order for
+    explicit file lists.
 
     Two strategies (reference's three at ``etl/dataset_metadata.py:244-290``;
     the ``_metadata`` summary-file path collapses into the JSON-key path here):

@@ -61,7 +61,7 @@ def init(rng, config: TransformerConfig) -> Dict:
     def dense(key, fan_in, shape):
         return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
 
-    keys = jax.random.split(rng, 4 + config.n_layers)
+    keys = jax.random.split(rng, 2 + config.n_layers)
     c = config
     params = {
         'embed': dense(keys[0], 1, (c.vocab_size, c.d_model)) * 0.02,
@@ -70,7 +70,7 @@ def init(rng, config: TransformerConfig) -> Dict:
         'layers': [],
     }
     for i in range(c.n_layers):
-        lk = jax.random.split(keys[4 + i], 8)
+        lk = jax.random.split(keys[2 + i], 8)
         layer = {
             'ln1': jnp.ones((c.d_model,), jnp.float32),
             'wq': dense(lk[0], c.d_model, (c.d_model, c.d_model)),

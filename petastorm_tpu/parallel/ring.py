@@ -45,13 +45,16 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True):
     q_pos = my_idx * l_local + jnp.arange(l_local)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, ring_step):
-        k_cur, v_cur, o, m, l = carry
+    def attend(k_cur, v_cur, o, m, l, ring_step):
         src_idx = (my_idx - ring_step) % n       # whose chunk we hold this step
         k_pos = src_idx * l_local + jnp.arange(l_local)
-        o, m, l = attention_block_step(
+        return attention_block_step(
             q32, k_cur, v_cur, o, m, l,
             q_positions=q_pos, k_positions=k_pos, causal=causal)
+
+    def step(carry, ring_step):
+        k_cur, v_cur, o, m, l = carry
+        o, m, l = attend(k_cur, v_cur, o, m, l, ring_step)
         # Rotate kv to the next device; XLA overlaps this with the next
         # iteration's compute when possible.
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -63,8 +66,11 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True):
     o0 = jnp.zeros_like(q32)
     m0 = jnp.full_like(q32[..., 0], -1e30)
     l0 = jnp.zeros_like(q32[..., 0])
+    # n-1 rotate-and-attend steps, then fold the last visiting chunk without
+    # rotating it onward (the n-th ppermute's output is never read).
     (k_fin, v_fin, o, m, l), _ = jax.lax.scan(
-        step, (k32, v32, o0, m0, l0), jnp.arange(n))
+        step, (k32, v32, o0, m0, l0), jnp.arange(n - 1))
+    o, m, l = attend(k_fin, v_fin, o, m, l, n - 1)
     return finalize_attention(o, l).astype(orig_dtype)
 
 

@@ -1,0 +1,84 @@
+"""Schema-driven fake reader: yields synthetic schema-compliant rows with zero
+I/O — for testing adapters/loaders without a dataset on disk.
+
+Reference parity: ``petastorm/test_util/reader_mock.py:19-82``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from petastorm_tpu.unischema import Unischema
+
+
+def schema_data_generator_example(schema: Unischema, rng=None):
+    """Generate one schema-compliant row dict with random values."""
+    rng = rng or np.random.default_rng()
+    row = {}
+    for field in schema.fields.values():
+        shape = tuple(s if s is not None else rng.integers(1, 4)
+                      for s in (field.shape or ()))
+        dtype = field.numpy_dtype
+        if dtype in (str, np.str_):
+            value = ('mock_' + str(rng.integers(0, 1000)) if not shape
+                     else np.array(['mock'] * int(np.prod(shape))).reshape(shape))
+        elif dtype in (bytes, np.bytes_):
+            value = b'mock'
+        else:
+            dt = np.dtype(dtype)
+            if dt.kind in 'iu':
+                value = np.asarray(rng.integers(0, 100, size=shape)).astype(dt)
+            elif dt.kind == 'b':
+                value = np.asarray(rng.integers(0, 2, size=shape) > 0)
+            else:
+                value = np.asarray(rng.random(size=shape)).astype(dt)
+            if not shape:
+                value = dt.type(value.item())
+        row[field.name] = value
+    return row
+
+
+class ReaderMock(object):
+    """Duck-types the Reader iteration surface (schema, batched_output, ngram,
+    __iter__/__next__, reset/stop/join) over a row generator function."""
+
+    def __init__(self, schema: Unischema, schema_data_generator=None,
+                 num_rows: int = 1000, seed: int = 0):
+        self.schema = schema
+        self.ngram = None
+        self.batched_output = False
+        self.last_row_consumed = False
+        self._generator = schema_data_generator or schema_data_generator_example
+        self._num_rows = num_rows
+        self._seed = seed
+        self._produced = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._produced >= self._num_rows:
+            self.last_row_consumed = True
+            raise StopIteration
+        rng = np.random.default_rng(self._seed + self._produced)
+        self._produced += 1
+        row = self._generator(self.schema, rng)
+        return self.schema.make_namedtuple(**row)
+
+    next = __next__
+
+    def reset(self):
+        self._produced = 0
+        self.last_row_consumed = False
+
+    def stop(self):
+        pass
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        pass

@@ -1,0 +1,42 @@
+"""Shuffle-quality analysis: quantify how decorrelated a shuffled id stream is
+from the unshuffled read order.
+
+Reference parity: ``petastorm/test_util/shuffling_analysis.py:30-85`` — the
+reference correlates shuffled vs unshuffled id streams over multiple reads;
+``compute_correlation_distance`` here is the same statistic usable in tests:
+values near 0 mean well shuffled, near 1 mean order preserved.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def compute_correlation_distance(shuffled_ids, unshuffled_ids) -> float:
+    """|Pearson correlation| between the positions of each id in the two
+    streams (0 = fully decorrelated order, 1 = identical/reversed order)."""
+    shuffled_ids = np.asarray(shuffled_ids)
+    unshuffled_ids = np.asarray(unshuffled_ids)
+    if sorted(shuffled_ids.tolist()) != sorted(unshuffled_ids.tolist()):
+        raise ValueError('Streams must contain the same multiset of ids')
+    pos_in_shuffled = {v: i for i, v in enumerate(shuffled_ids.tolist())}
+    positions = np.array([pos_in_shuffled[v] for v in unshuffled_ids.tolist()])
+    baseline = np.arange(len(positions))
+    if len(positions) < 2:
+        return 1.0
+    corr = np.corrcoef(positions, baseline)[0, 1]
+    return float(abs(corr))
+
+
+def analyze_shuffling_quality(reader_factory, num_reads: int = 3) -> float:
+    """Open the reader ``num_reads + 1`` times: the first unshuffled pass is
+    the baseline; returns the mean correlation distance of subsequent passes
+    (reference ``analyze_shuffling_quality``)."""
+    with reader_factory(shuffle=False) as reader:
+        baseline = [row.id for row in reader]
+    distances = []
+    for _ in range(num_reads):
+        with reader_factory(shuffle=True) as reader:
+            shuffled = [row.id for row in reader]
+        distances.append(compute_correlation_distance(shuffled, baseline))
+    return float(np.mean(distances))

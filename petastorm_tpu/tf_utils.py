@@ -1,0 +1,153 @@
+"""TensorFlow adapter (reference parity: ``petastorm/tf_utils.py``).
+
+Provides ``make_petastorm_dataset(reader)`` → ``tf.data.Dataset`` via
+``from_generator`` with static-shape fixup, plus the dtype/value sanitization
+table (uint16→int32, uint32→int64, Decimal→string, datetime64→int64 ns).
+The legacy graph-mode ``tf_tensors`` queue API is intentionally not ported:
+``tf.data`` is the supported ingestion path in TF2 (reference itself routes
+``make_petastorm_dataset`` this way, ``tf_utils.py:329-399``).
+
+TensorFlow is imported lazily so the rest of the framework never pays for it.
+"""
+
+from __future__ import annotations
+
+import datetime
+from decimal import Decimal
+
+import numpy as np
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+def _field_tf_dtype(field):
+    """numpy dtype -> tf dtype incl. promotions (reference ``tf_utils.py:27-44``):
+    uint16→int32, uint32→int64, Decimal→string, datetime→int64 ns."""
+    tf = _tf()
+    np_dtype = field.numpy_dtype
+    if np_dtype in (str, bytes, Decimal, np.str_, np.bytes_):
+        return tf.string
+    if np_dtype in (np.datetime64, datetime.date, datetime.datetime):
+        return tf.int64
+    dt = np.dtype(np_dtype)
+    if dt == np.uint16:
+        return tf.int32
+    if dt == np.uint32:
+        return tf.int64
+    if dt.kind == 'M':
+        return tf.int64
+    return tf.as_dtype(dt)
+
+
+def _sanitize_field_tf_types(value):
+    """Make one field value feedable to TF (reference ``tf_utils.py:58-97``)."""
+    if value is None:
+        raise RuntimeError('Null values are not supported by the TF adapter; '
+                           'use a TransformSpec to fill nulls')
+    if isinstance(value, Decimal):
+        return str(value)
+    arr = np.asarray(value)
+    if arr.dtype.kind == 'M':
+        return arr.astype('datetime64[ns]').astype(np.int64)
+    if arr.dtype == np.uint16:
+        return arr.astype(np.int32)
+    if arr.dtype == np.uint32:
+        return arr.astype(np.int64)
+    if arr.dtype.kind == 'O':
+        if arr.size and isinstance(arr.flat[0], Decimal):
+            return arr.astype(str)
+        return arr.astype(str) if arr.size and isinstance(arr.flat[0], str) else arr
+    return arr
+
+
+def _sanitize_row(row_dict):
+    return {k: _sanitize_field_tf_types(v) for k, v in row_dict.items()}
+
+
+def make_petastorm_dataset(reader):
+    """Build a ``tf.data.Dataset`` over a row or batch reader
+    (reference ``tf_utils.py:329-399``).
+
+    Elements are namedtuples of tensors (one row each for ``make_reader``, one
+    row-group batch each for ``make_batch_reader``; apply
+    ``.unbatch()``/``.flat_map`` + ``.batch()`` for fixed-size training
+    batches). The dataset is single-pass per reader epoch set: use
+    ``num_epochs=None`` in the reader instead of ``.repeat()``
+    (reference refuses re-iteration the same way, ``tf_utils.py:366-374``).
+    """
+    tf = _tf()
+    schema = reader.schema
+    if getattr(reader, 'ngram', None) is not None:
+        return _make_ngram_dataset(reader)
+
+    fields = list(schema.fields.values())
+    names = [f.name for f in fields]
+    output_types = tuple(_field_tf_dtype(f) for f in fields)
+
+    def generator():
+        for item in reader:
+            row = item._asdict() if hasattr(item, '_asdict') else dict(item)
+            sane = _sanitize_row(row)
+            yield tuple(sane[n] for n in names)
+
+    dataset = tf.data.Dataset.from_generator(generator, output_types)
+
+    batched = reader.batched_output
+
+    def set_shape_and_name(*row):
+        out = []
+        for value, field in zip(row, fields):
+            shape = tuple(field.shape or ())
+            static = tuple(s if s is not None else None for s in shape)
+            if batched:
+                static = (None,) + static
+            try:
+                value.set_shape(static)
+            except ValueError:
+                pass  # ragged/opaque: leave dynamic
+            out.append(value)
+        # namedtuple row type with tensor values (same type the raw reader
+        # yields for decoded rows)
+        return schema.make_batch_namedtuple(**dict(zip(names, out)))
+
+    return dataset.map(set_shape_and_name)
+
+
+def _make_ngram_dataset(reader):
+    """NGram rows are {offset: namedtuple}; flatten across the generator
+    boundary and rebuild the dict of namedtuples (reference
+    ``tf_utils.py:141-183,402-433``)."""
+    tf = _tf()
+    ngram = reader.ngram
+    timesteps = sorted(ngram.fields.keys())
+    flat_fields = []
+    for ts in timesteps:
+        schema_at_ts = ngram.get_schema_at_timestep(reader.schema, ts)
+        for f in schema_at_ts.fields.values():
+            flat_fields.append((ts, f))
+    output_types = tuple(_field_tf_dtype(f) for _, f in flat_fields)
+
+    def generator():
+        for item in reader:
+            out = []
+            for ts, f in flat_fields:
+                value = getattr(item[ts], f.name)
+                out.append(_sanitize_field_tf_types(value))
+            yield tuple(out)
+
+    dataset = tf.data.Dataset.from_generator(generator, output_types)
+
+    def unflatten(*flat):
+        result = {}
+        idx = 0
+        for ts in timesteps:
+            schema_at_ts = ngram.get_schema_at_timestep(reader.schema, ts)
+            names = list(schema_at_ts.fields.keys())
+            result[ts] = dict(zip(names, flat[idx:idx + len(names)]))
+            idx += len(names)
+        return result
+
+    return dataset.map(unflatten)

@@ -1,0 +1,115 @@
+"""Dataset converter tests (reference ``tests/test_spark_dataset_converter.py``,
+de-Spark-ified)."""
+
+import pickle
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu import converter as conv
+from petastorm_tpu.converter import make_dataset_converter, set_parent_cache_dir_url
+
+
+@pytest.fixture(autouse=True)
+def cache_dir(tmp_path):
+    url = 'file://' + str(tmp_path / 'conv_cache')
+    set_parent_cache_dir_url(url)
+    conv._materialized.clear()
+    yield url
+    set_parent_cache_dir_url(None)
+    conv._materialized.clear()
+
+
+def _table(n=100):
+    return pa.table({'id': np.arange(n, dtype=np.int64),
+                     'value': np.arange(n, dtype=np.float64) * 0.5})
+
+
+class TestMaterialization:
+    def test_roundtrip_jax_loader(self):
+        saved = make_dataset_converter(_table())
+        assert len(saved) == 100
+        with saved.make_jax_loader(batch_size=20, num_epochs=1,
+                                   reader_pool_type='dummy') as loader:
+            ids = [i for b in loader for i in b['id'].tolist()]
+        assert sorted(ids) == list(range(100))
+
+    def test_pandas_input(self):
+        df = pd.DataFrame({'id': np.arange(10), 'x': np.ones(10)})
+        saved = make_dataset_converter(df)
+        assert len(saved) == 10
+
+    def test_cache_hit_same_content(self):
+        s1 = make_dataset_converter(_table())
+        s2 = make_dataset_converter(_table())
+        assert s1.cache_dir_url == s2.cache_dir_url
+
+    def test_cache_miss_on_different_content(self):
+        s1 = make_dataset_converter(_table(100))
+        s2 = make_dataset_converter(_table(101))
+        assert s1.cache_dir_url != s2.cache_dir_url
+
+    def test_cache_miss_on_params(self):
+        s1 = make_dataset_converter(_table())
+        s2 = make_dataset_converter(_table(), compression='snappy')
+        assert s1.cache_dir_url != s2.cache_dir_url
+
+    def test_precision_float32(self):
+        saved = make_dataset_converter(_table(), precision='float32')
+        with saved.make_jax_loader(batch_size=10, num_epochs=1,
+                                   reader_pool_type='dummy') as loader:
+            batch = next(iter(loader))
+        assert batch['value'].dtype == np.float32
+
+    def test_pickle_handle(self):
+        saved = make_dataset_converter(_table())
+        clone = pickle.loads(pickle.dumps(saved))
+        with clone.make_jax_loader(batch_size=50, num_epochs=1,
+                                   reader_pool_type='dummy') as loader:
+            ids = [i for b in loader for i in b['id'].tolist()]
+        assert sorted(ids) == list(range(100))
+
+    def test_delete(self):
+        import fsspec
+        saved = make_dataset_converter(_table())
+        fs = fsspec.filesystem('file')
+        path = saved.cache_dir_url[len('file://'):]
+        assert fs.exists(path)
+        saved.delete()
+        assert not fs.exists(path)
+        # next conversion re-materializes
+        s2 = make_dataset_converter(_table())
+        assert fs.exists(s2.cache_dir_url[len('file://'):])
+
+
+class TestTorchAndTf:
+    def test_torch_dataloader(self):
+        torch = pytest.importorskip('torch')
+        saved = make_dataset_converter(_table())
+        with saved.make_torch_dataloader(batch_size=25, num_epochs=1,
+                                         reader_pool_type='dummy') as loader:
+            batches = list(loader)
+        assert sum(len(b['id']) for b in batches) == 100
+        assert isinstance(batches[0]['id'], torch.Tensor)
+
+    def test_tf_dataset(self):
+        pytest.importorskip('tensorflow')
+        saved = make_dataset_converter(_table())
+        with saved.make_tf_dataset(batch_size=10, num_epochs=1,
+                                   reader_pool_type='dummy') as dataset:
+            ids = [int(i) for b in dataset for i in b.id.numpy()]
+        assert sorted(ids) == list(range(100))
+
+
+class TestRankDetection:
+    def test_env_var_mismatch_warns(self, monkeypatch):
+        monkeypatch.setenv('HOROVOD_RANK', '1')
+        monkeypatch.setenv('HOROVOD_SIZE', '4')
+        saved = make_dataset_converter(_table())
+        with pytest.warns(UserWarning, match='rank 1 of 4'):
+            with saved.make_jax_loader(batch_size=10, num_epochs=1,
+                                       reader_pool_type='dummy',
+                                       cur_shard=0, shard_count=2) as loader:
+                list(loader)

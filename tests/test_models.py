@@ -185,7 +185,8 @@ class TestGraftEntry:
                                          '__graft_entry__.py'))
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        for n in (1, 2, 4, 6, 8):
+        for n in (1, 2, 4, 6, 8, 16, 24, 81, 245, 256):
             axes = mod._factor_axes(n)
-            assert np.prod(list(axes.values())) == n
-            assert axes['model'] <= 4
+            assert np.prod(list(axes.values())) == n, (n, axes)
+            assert axes['model'] in (1, 2, 4)
+            assert axes['seq'] in (1, 2, 4, 8)

@@ -1,0 +1,122 @@
+"""PyTorch adapter tests (reference ``tests/test_pytorch_dataloader.py``)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip('torch')
+
+from petastorm_tpu.pytorch import (BatchedDataLoader, DataLoader,  # noqa: E402
+                                   _sanitize_pytorch_types,
+                                   decimal_friendly_collate)
+from petastorm_tpu.reader import make_batch_reader, make_reader  # noqa: E402
+
+
+def _all_ids(batches, key='id'):
+    out = []
+    for b in batches:
+        out.extend(np.asarray(b[key]).ravel().tolist())
+    return out
+
+
+class TestSanitize:
+    def test_promotions(self):
+        from decimal import Decimal
+        row = {'b': np.array([True, False]),
+               'u16': np.array([1, 2], np.uint16),
+               'u32': np.array([1, 2], np.uint32),
+               'd': Decimal('2.5')}
+        out = _sanitize_pytorch_types(row)
+        assert out['b'].dtype == np.uint8
+        assert out['u16'].dtype == np.int32
+        assert out['u32'].dtype == np.int64
+        assert out['d'] == 2.5
+
+    def test_none_rejected(self):
+        with pytest.raises(TypeError, match='None'):
+            _sanitize_pytorch_types({'x': None})
+
+
+class TestDataLoader:
+    def test_row_reader(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         num_epochs=1, schema_fields=['id', 'matrix']) as reader:
+            loader = DataLoader(reader, batch_size=10)
+            batches = list(loader)
+        assert sorted(_all_ids(batches)) == sorted(
+            r['id'] for r in synthetic_dataset.data)
+        assert isinstance(batches[0]['matrix'], torch.Tensor)
+        assert batches[0]['matrix'].shape == (10, 8, 4, 3)
+
+    def test_batched_reader_transposed(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                               num_epochs=1,
+                               schema_fields=['^id$', 'float64']) as reader:
+            loader = DataLoader(reader, batch_size=16)
+            batches = list(loader)
+        assert sorted(_all_ids(batches)) == sorted(
+            r['id'] for r in scalar_dataset.data)
+
+    def test_shuffling(self, synthetic_dataset):
+        def ids(capacity, seed=3):
+            with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                             num_epochs=1, shuffle_row_groups=False,
+                             schema_fields=['id']) as reader:
+                return _all_ids(list(DataLoader(
+                    reader, batch_size=10,
+                    shuffling_queue_capacity=capacity, seed=seed)))
+
+        plain, shuffled = ids(0), ids(50)
+        assert sorted(plain) == sorted(shuffled)
+        assert plain != shuffled
+
+
+class TestBatchedDataLoader:
+    def test_vectorized_batches(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                               num_epochs=1) as reader:
+            loader = BatchedDataLoader(reader, batch_size=16)
+            batches = list(loader)
+        assert sorted(_all_ids(batches)) == sorted(
+            r['id'] for r in scalar_dataset.data)
+        assert isinstance(batches[0]['id'], torch.Tensor)
+
+    def test_requires_batched_reader(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy') as reader:
+            with pytest.raises(ValueError, match='batched reader'):
+                BatchedDataLoader(reader)
+
+    def test_inmemory_cache(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                               num_epochs=1) as reader:
+            loader = BatchedDataLoader(reader, batch_size=16,
+                                       inmemory_cache_all=True)
+            first = _all_ids(list(loader))
+            second = _all_ids(list(loader))
+        assert first == second
+
+    def test_shuffled_batches(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                               num_epochs=1, shuffle_row_groups=False) as reader:
+            loader = BatchedDataLoader(reader, batch_size=10,
+                                       shuffling_queue_capacity=40, seed=0)
+            ids = _all_ids(list(loader))
+        assert sorted(ids) == sorted(r['id'] for r in scalar_dataset.data)
+        assert ids != sorted(ids)
+
+    def test_transform_fn(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                               num_epochs=1) as reader:
+            loader = BatchedDataLoader(
+                reader, batch_size=8,
+                transform_fn=lambda b: {'double': b['id'] * 2})
+            batch = next(iter(loader))
+        assert set(batch.keys()) == {'double'}
+
+
+class TestCollate:
+    def test_mixed_fields(self):
+        rows = [{'x': np.float32(1.0), 's': 'a'},
+                {'x': np.float32(2.0), 's': 'bb'}]
+        out = decimal_friendly_collate(rows)
+        assert isinstance(out['x'], torch.Tensor)
+        assert out['s'] == ['a', 'bb']

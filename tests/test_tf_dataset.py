@@ -1,0 +1,77 @@
+"""TF adapter tests (reference ``tests/test_tf_dataset.py``)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip('tensorflow')
+
+from petastorm_tpu.ngram import NGram  # noqa: E402
+from petastorm_tpu.reader import make_batch_reader, make_reader  # noqa: E402
+from petastorm_tpu.tf_utils import make_petastorm_dataset  # noqa: E402
+
+
+class TestRowDataset:
+    def test_values_roundtrip(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         num_epochs=1,
+                         schema_fields=['id', 'matrix', 'partition_key']) as reader:
+            dataset = make_petastorm_dataset(reader)
+            rows = list(dataset)
+        by_id = {r['id']: r for r in synthetic_dataset.data}
+        assert len(rows) == len(by_id)
+        for row in rows:
+            rid = int(row.id.numpy())
+            np.testing.assert_array_equal(row.matrix.numpy(), by_id[rid]['matrix'])
+            assert row.partition_key.numpy().decode() == by_id[rid]['partition_key']
+
+    def test_static_shapes(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         num_epochs=1, schema_fields=['id', 'matrix']) as reader:
+            dataset = make_petastorm_dataset(reader)
+            spec = dataset.element_spec
+        assert tuple(spec.matrix.shape) == (8, 4, 3)
+
+    def test_batch_pipeline(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         num_epochs=1, schema_fields=['id']) as reader:
+            dataset = make_petastorm_dataset(reader).batch(10)
+            ids = [int(i) for b in dataset for i in b.id.numpy()]
+        assert sorted(ids) == sorted(r['id'] for r in synthetic_dataset.data)
+
+
+class TestBatchDataset:
+    def test_batched_reader(self, scalar_dataset):
+        with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                               num_epochs=1,
+                               schema_fields=['^id$', 'float64']) as reader:
+            dataset = make_petastorm_dataset(reader)
+            ids = [int(i) for b in dataset for i in b.id.numpy()]
+        assert sorted(ids) == sorted(r['id'] for r in scalar_dataset.data)
+
+    def test_uint16_promotion(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         num_epochs=1,
+                         schema_fields=['id', 'matrix_uint16']) as reader:
+            dataset = make_petastorm_dataset(reader)
+            row = next(iter(dataset))
+        assert row.matrix_uint16.dtype == tf.int32
+
+
+class TestNgramDataset:
+    def test_ngram_windows(self, tmp_path):
+        # the session fixture uses ~1-row row groups; ngram windows need
+        # multi-row groups (sequences never cross row-group boundaries)
+        from petastorm_tpu.test_util.dataset_gen import create_test_dataset
+        url = 'file://' + str(tmp_path / 'ngram_ds')
+        create_test_dataset(url, range(30), num_files=2, row_group_size_mb=100)
+        fields = {0: ['id', 'matrix'], 1: ['id']}
+        ngram = NGram(fields=fields, delta_threshold=10, timestamp_field='id')
+        with make_reader(url, reader_pool_type='dummy',
+                         num_epochs=1, schema_fields=ngram,
+                         shuffle_row_groups=False) as reader:
+            dataset = make_petastorm_dataset(reader)
+            windows = list(dataset)
+        assert windows
+        for w in windows:
+            assert set(w.keys()) == {0, 1}
+            assert int(w[1]['id'].numpy()) == int(w[0]['id'].numpy()) + 1
