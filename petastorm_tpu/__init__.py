@@ -11,6 +11,7 @@ from petastorm_tpu.errors import NoDataAvailableError  # noqa: F401
 from petastorm_tpu.transform import TransformSpec  # noqa: F401
 
 __all__ = ['make_reader', 'make_batch_reader', 'TransformSpec', 'NoDataAvailableError',
+           'make_jax_loader', 'make_dataset_converter', 'materialize_dataset',
            '__version__']
 
 
@@ -19,4 +20,13 @@ def __getattr__(name):
     if name in ('make_reader', 'make_batch_reader'):
         from petastorm_tpu import reader
         return getattr(reader, name)
+    if name == 'make_jax_loader':
+        from petastorm_tpu.jax_utils import make_jax_loader
+        return make_jax_loader
+    if name == 'make_dataset_converter':
+        from petastorm_tpu.converter import make_dataset_converter
+        return make_dataset_converter
+    if name == 'materialize_dataset':
+        from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+        return materialize_dataset
     raise AttributeError('module {!r} has no attribute {!r}'.format(__name__, name))

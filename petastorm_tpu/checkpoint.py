@@ -1,0 +1,112 @@
+"""Checkpointable iteration state for training loops.
+
+The reference has no resume support: its unit of progress is the whole epoch
+(``reader.py:468-492``; SURVEY §5.4). This module closes that gap on top of the
+deterministic foundations this framework ships (seeded ventilator shuffle,
+seeded shuffling buffers, deterministic piece ordering):
+
+- :class:`CheckpointableLoader` wraps a loader *factory* and tracks
+  ``(epoch, step)``. ``state_dict()`` is a tiny JSON-able dict that can ride
+  inside any model checkpoint (orbax/flax/torch). ``load_state_dict()`` +
+  iteration fast-forwards a freshly built loader to the saved position.
+
+Exact resume requires the batch stream to be reproducible: pass a ``seed`` to
+the reader (shuffle order) and loader (buffer RNG), and use a deterministic
+results order (``reader_pool_type='dummy'`` or ``workers_count=1``). With a
+nondeterministic pool the resume is best-effort: epoch boundaries are exact,
+the intra-epoch position is approximate.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict
+
+logger = logging.getLogger(__name__)
+
+
+class CheckpointableLoader(object):
+    """Iterate ``loader_factory()`` epochs while tracking a resumable cursor.
+
+    :param loader_factory: zero-arg callable returning a fresh single-epoch
+        iterable of batches (e.g. a lambda building ``make_reader`` +
+        ``JaxDataLoader`` with fixed seeds). A new loader is built per epoch so
+        epoch boundaries stay clean after restore.
+
+    Usage::
+
+        ckpt_loader = CheckpointableLoader(make_loader)
+        for batch in ckpt_loader.epochs(num_epochs=10):
+            train_step(batch)
+            if should_save():
+                save(model_state, data_state=ckpt_loader.state_dict())
+
+        # later, in a new process
+        ckpt_loader = CheckpointableLoader(make_loader)
+        ckpt_loader.load_state_dict(saved['data_state'])
+        for batch in ckpt_loader.epochs(num_epochs=10):   # resumes mid-epoch
+            ...
+    """
+
+    def __init__(self, loader_factory: Callable[[], object]):
+        self._factory = loader_factory
+        self.epoch = 0
+        self.step = 0          # batches yielded in the current epoch
+        self._skip = 0         # pending fast-forward after load_state_dict
+
+    # -- state ----------------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, int]:
+        # A restore that has not started iterating yet still owes `_skip`
+        # batches; report it so save-before-resume does not regress the cursor.
+        return {'epoch': self.epoch, 'step': max(self.step, self._skip),
+                'version': 1}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        if state.get('version', 1) != 1:
+            raise ValueError('Unknown checkpoint state version {}'.format(
+                state.get('version')))
+        self.epoch = int(state['epoch'])
+        self.step = 0
+        self._skip = int(state['step'])
+
+    # -- iteration ------------------------------------------------------------
+
+    def epochs(self, num_epochs: int):
+        """Yield batches of epochs ``[self.epoch, num_epochs)``, fast-forwarding
+        ``step`` batches into the first epoch after a restore."""
+        while self.epoch < num_epochs:
+            yield from self._one_epoch()
+            self.epoch += 1
+            self.step = 0
+
+    def _one_epoch(self):
+        loader = self._factory()
+        skip = self._skip
+        self._skip = 0
+        if skip:
+            logger.info('Fast-forwarding %d batches into epoch %d', skip,
+                        self.epoch)
+        self.step = 0   # absolute batch index within the epoch, incl. skipped
+        try:
+            for batch in iter(loader):
+                self.step += 1
+                if self.step <= skip:
+                    continue
+                yield batch
+            if self.step < skip:
+                # The epoch was shorter than the saved cursor (dataset shrank
+                # or nondeterministic stream); surface it rather than silently
+                # yielding a truncated next epoch.
+                logger.warning('Checkpoint cursor %d exceeds epoch length %d',
+                               skip, self.step)
+        finally:
+            # Loaders own reader worker pools; release them per epoch.
+            for method in ('stop', 'join'):
+                fn = getattr(loader, method, None) or getattr(
+                    getattr(loader, 'reader', None), method, None)
+                if fn is not None:
+                    try:
+                        fn()
+                    except Exception:  # cleanup must not mask iteration errors
+                        logger.exception('Loader %s() failed', method)

@@ -333,6 +333,13 @@ def get_schema(filesystem, dataset_path: str) -> Unischema:
             '`python -m petastorm_tpu.etl.generate_metadata <url>` to add metadata to '
             'an existing store, or read it with make_batch_reader.'.format(dataset_path))
     if UNISCHEMA_KEY not in metadata:
+        from petastorm_tpu.compat import (PETASTORM_UNISCHEMA_KEY,
+                                          unischema_from_petastorm_pickle)
+        if PETASTORM_UNISCHEMA_KEY in metadata:
+            # Dataset written by original petastorm: decode its pickled schema
+            # through the restricted compat unpickler.
+            return unischema_from_petastorm_pickle(
+                metadata[PETASTORM_UNISCHEMA_KEY])
         raise PetastormMetadataError(
             '_common_metadata at {} does not carry a unischema (key {}). Was this '
             'dataset written by petastorm_tpu.materialize_dataset?'.format(

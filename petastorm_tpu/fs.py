@@ -53,18 +53,42 @@ def normalize_dataset_url_or_urls(dataset_url_or_urls):
 
 class FilesystemFactory:
     """Picklable callable producing a fresh fsspec filesystem — usable in spawned
-    worker processes (reference ``filesystem_factory`` concept, ``fs_utils.py:170-199``)."""
+    worker processes (reference ``filesystem_factory`` concept, ``fs_utils.py:170-199``).
 
-    def __init__(self, protocol: str, storage_options: Optional[Dict] = None):
+    For HDFS HA name services (resolved from Hadoop XML configs) the factory
+    returns an :class:`petastorm_tpu.hdfs.namenode.HAHdfsClient` that retries
+    calls across namenodes (reference ``hdfs/namenode.py:241-319``)."""
+
+    def __init__(self, protocol: str, storage_options: Optional[Dict] = None,
+                 hdfs_namenodes: Optional[list] = None):
         self._protocol = protocol
         self._storage_options = dict(storage_options or {})
+        self._hdfs_namenodes = hdfs_namenodes
 
     def __call__(self):
+        if self._hdfs_namenodes:
+            from petastorm_tpu.hdfs.namenode import HdfsConnector
+            return HdfsConnector.connect_to_either_namenode(self._hdfs_namenodes)
         import fsspec
         return fsspec.filesystem(self._protocol, **self._storage_options)
 
     def __repr__(self):
         return 'FilesystemFactory({!r})'.format(self._protocol)
+
+
+def _resolve_hdfs_namenodes(url: str) -> Optional[list]:
+    """Namenode list when the url's authority is a configured HA name service
+    (requires HADOOP_HOME-style configs); None otherwise."""
+    netloc = urlparse(url).netloc
+    if not netloc or ':' in netloc:
+        return None   # explicit host:port — not a name service
+    try:
+        from petastorm_tpu.hdfs.namenode import HdfsNamenodeResolver
+        return HdfsNamenodeResolver().resolve_hdfs_name_service(netloc)
+    except Exception:
+        logger.debug('HDFS name service resolution failed for %s', url,
+                     exc_info=True)
+        return None
 
 
 def _parse_url(url: str) -> Tuple[str, str]:
@@ -109,8 +133,11 @@ def get_filesystem_and_path_or_paths(url_or_urls, storage_options: Optional[Dict
         raise ValueError('All urls must be on the same filesystem, got {}'.format(protocols))
     protocol = parsed[0][0]
     paths = [path for _, path in parsed]
-    factory = FilesystemFactory(protocol, storage_options)
-    fs = fsspec.filesystem(protocol, **(storage_options or {}))
+    hdfs_namenodes = _resolve_hdfs_namenodes(urls[0]) if protocol == 'hdfs' else None
+    factory = FilesystemFactory(protocol, storage_options,
+                                hdfs_namenodes=hdfs_namenodes)
+    fs = factory() if hdfs_namenodes else fsspec.filesystem(
+        protocol, **(storage_options or {}))
     path_or_paths = paths if isinstance(url_or_urls, list) else paths[0]
     return fs, path_or_paths, factory
 

@@ -1,0 +1,1 @@
+"""HDFS namenode resolution + HA failover (reference ``petastorm/hdfs/``)."""

@@ -1,0 +1,5 @@
+"""pyarrow utilities (reference ``petastorm/pyarrow_helpers/``)."""
+
+from petastorm_tpu.pyarrow_helpers.batching_table_queue import BatchingTableQueue
+
+__all__ = ['BatchingTableQueue']

@@ -1,0 +1,179 @@
+"""Compat tests: read a dataset carrying original-petastorm pickled metadata.
+
+The test forges the reference's pickle format by registering fake
+``petastorm.*`` / ``pyspark.sql.types`` modules whose classes mirror the
+reference's attribute layout (``petastorm/unischema.py:50-69,174-190``,
+``codecs.py:59-66,215-222``), pickling a schema instance, and installing it
+into ``_common_metadata`` under ``dataset-toolkit.unischema.v1``. Data files
+keep the same wire format (np.save bytes, png bytes, native scalars), so a
+genuine petastorm dataset is indistinguishable from this fixture.
+"""
+
+import pickle
+import sys
+import types
+from collections import OrderedDict
+from typing import Any, NamedTuple, Optional, Tuple
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.compat import (PETASTORM_UNISCHEMA_KEY,
+                                  unischema_from_petastorm_pickle)
+
+
+@pytest.fixture()
+def fake_petastorm_modules():
+    """Install modules that pickle to the same class paths as the reference."""
+    created = []
+
+    def module(name):
+        mod = types.ModuleType(name)
+        sys.modules[name] = mod
+        created.append(name)
+        return mod
+
+    pet = module('petastorm')
+    uni = module('petastorm.unischema')
+    cod = module('petastorm.codecs')
+    pyspark = module('pyspark')
+    sql = module('pyspark.sql')
+    sqltypes = module('pyspark.sql.types')
+    pet.unischema = uni
+    pet.codecs = cod
+    pyspark.sql = sql
+    sql.types = sqltypes
+
+    class UnischemaField(NamedTuple):
+        name: str
+        numpy_dtype: Any
+        shape: Tuple[Optional[int], ...]
+        codec: Optional[Any] = None
+        nullable: Optional[bool] = False
+
+    class Unischema(object):
+        def __init__(self, name, fields):
+            self._name = name
+            self._fields = OrderedDict([(f.name, f) for f in fields])
+            for f in fields:
+                if not hasattr(self, f.name):
+                    setattr(self, f.name, f)
+
+    class IntegerType(object):
+        pass
+
+    class ScalarCodec(object):
+        def __init__(self, spark_type):
+            self._spark_type = spark_type
+
+    class NdarrayCodec(object):
+        pass
+
+    class CompressedImageCodec(object):
+        def __init__(self, image_codec='png', quality=80):
+            self._image_codec = '.' + image_codec
+            self._quality = quality
+
+    for cls in (UnischemaField, Unischema):
+        cls.__module__ = 'petastorm.unischema'
+        cls.__qualname__ = cls.__name__
+        setattr(uni, cls.__name__, cls)
+    for cls in (ScalarCodec, NdarrayCodec, CompressedImageCodec):
+        cls.__module__ = 'petastorm.codecs'
+        cls.__qualname__ = cls.__name__
+        setattr(cod, cls.__name__, cls)
+    IntegerType.__module__ = 'pyspark.sql.types'
+    IntegerType.__qualname__ = 'IntegerType'
+    sqltypes.IntegerType = IntegerType
+
+    yield types.SimpleNamespace(Unischema=Unischema,
+                                UnischemaField=UnischemaField,
+                                ScalarCodec=ScalarCodec,
+                                NdarrayCodec=NdarrayCodec,
+                                CompressedImageCodec=CompressedImageCodec,
+                                IntegerType=IntegerType)
+    for name in created:
+        del sys.modules[name]
+
+
+def _forge_schema_pickle(fake):
+    schema = fake.Unischema('LegacySchema', [
+        fake.UnischemaField('id', np.int32, (), fake.ScalarCodec(fake.IntegerType()), False),
+        fake.UnischemaField('matrix', np.float32, (4, 3), fake.NdarrayCodec(), False),
+        fake.UnischemaField('image', np.uint8, (8, 6, 3),
+                            fake.CompressedImageCodec('png', quality=70), False),
+    ])
+    return pickle.dumps(schema)
+
+
+class TestUnpickle:
+    def test_decodes_fields_and_codecs(self, fake_petastorm_modules):
+        payload = _forge_schema_pickle(fake_petastorm_modules)
+        schema = unischema_from_petastorm_pickle(payload)
+        # alphabetical field order (reference _UNISCHEMA_FIELD_ORDER default)
+        assert list(schema.fields) == ['id', 'image', 'matrix']
+        assert schema.fields['matrix'].shape == (4, 3)
+        assert schema.fields['image'].codec.__class__.__name__ == 'CompressedImageCodec'
+        assert np.dtype(schema.fields['id'].numpy_dtype) == np.int32
+
+    def test_rejects_unknown_globals(self):
+        class Evil(object):
+            def __reduce__(self):
+                return (print, ('pwned',))
+        with pytest.raises(pickle.UnpicklingError, match='Refusing'):
+            unischema_from_petastorm_pickle(pickle.dumps(Evil()))
+
+
+class TestEndToEnd:
+    def test_read_petastorm_written_dataset(self, fake_petastorm_modules, tmp_path):
+        """Write data files in the shared wire format, install petastorm-style
+        pickled metadata, read through make_reader."""
+        from petastorm_tpu.codecs import (CompressedImageCodec, NdarrayCodec,
+                                          ScalarCodec)
+        from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+        from petastorm_tpu.reader import make_reader
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+
+        url = 'file://' + str(tmp_path / 'legacy_ds')
+        native = Unischema('LegacySchema', [
+            UnischemaField('id', np.int32, (), ScalarCodec(), False),
+            UnischemaField('matrix', np.float32, (4, 3), NdarrayCodec(), False),
+            UnischemaField('image', np.uint8, (8, 6, 3), CompressedImageCodec('png'), False),
+        ])
+        rng = np.random.default_rng(0)
+        rows = [{'id': np.int32(i),
+                 'matrix': rng.standard_normal((4, 3)).astype(np.float32),
+                 'image': rng.integers(0, 255, (8, 6, 3), dtype=np.uint8)}
+                for i in range(20)]
+        with materialize_dataset(url, native, rows_per_file=10) as w:
+            w.write_rows(rows)
+
+        # Replace _common_metadata with petastorm-style pickled metadata only.
+        meta_path = tmp_path / 'legacy_ds' / '_common_metadata'
+        arrow_schema = pq.read_schema(str(meta_path))
+        payload = _forge_schema_pickle(fake_petastorm_modules)
+        pq.write_metadata(
+            arrow_schema.with_metadata({PETASTORM_UNISCHEMA_KEY: payload}),
+            str(meta_path))
+
+        with make_reader(url, reader_pool_type='dummy', num_epochs=1) as reader:
+            got = {row.id: row for row in reader}
+        assert len(got) == 20
+        for r in rows:
+            np.testing.assert_array_equal(got[int(r['id'])].matrix, r['matrix'])
+            np.testing.assert_array_equal(got[int(r['id'])].image, r['image'])
+
+
+class TestNumpyAllowlist:
+    def test_numpy_attack_surface_rejected(self):
+        # protocol-0 GLOBAL opcode resolving numpy.save, then STOP
+        evil = b'cnumpy\nsave\n.'
+        with pytest.raises(pickle.UnpicklingError, match='Refusing'):
+            unischema_from_petastorm_pickle(evil)
+
+    def test_numpy_dtype_still_allowed(self):
+        from petastorm_tpu.compat import _RestrictedUnpickler
+        import io
+        payload = pickle.dumps(np.dtype('float32'))
+        assert _RestrictedUnpickler(io.BytesIO(payload)).load() == np.dtype('float32')

@@ -1,0 +1,148 @@
+"""HDFS namenode resolution/failover (config-driven, no cluster) and
+BatchingTableQueue tests (reference ``tests/test_namenode_resolution.py``,
+``tests/test_batching_table_queue.py``)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.hdfs.namenode import (HAHdfsClient, HdfsConnectError,
+                                         HdfsNamenodeResolver,
+                                         MaxFailoversExceeded)
+from petastorm_tpu.pyarrow_helpers import BatchingTableQueue
+
+HA_CONFIG = {
+    'fs.defaultFS': 'hdfs://nameservice1',
+    'dfs.ha.namenodes.nameservice1': 'nn1,nn2',
+    'dfs.namenode.rpc-address.nameservice1.nn1': 'host1:8020',
+    'dfs.namenode.rpc-address.nameservice1.nn2': 'host2:8020',
+}
+
+
+class TestNamenodeResolver:
+    def test_resolves_ha_service(self):
+        r = HdfsNamenodeResolver(HA_CONFIG)
+        assert r.resolve_hdfs_name_service('nameservice1') == \
+            ['host1:8020', 'host2:8020']
+
+    def test_default_service(self):
+        r = HdfsNamenodeResolver(HA_CONFIG)
+        service, namenodes = r.resolve_default_hdfs_service()
+        assert service == 'nameservice1'
+        assert namenodes == ['host1:8020', 'host2:8020']
+
+    def test_non_ha_defaultfs(self):
+        r = HdfsNamenodeResolver({'fs.defaultFS': 'hdfs://single:8020'})
+        service, namenodes = r.resolve_default_hdfs_service()
+        assert namenodes == ['single:8020']
+
+    def test_unknown_service_returns_none(self):
+        r = HdfsNamenodeResolver(HA_CONFIG)
+        assert r.resolve_hdfs_name_service('other') is None
+
+    def test_missing_defaultfs_raises(self):
+        with pytest.raises(HdfsConnectError):
+            HdfsNamenodeResolver({}).resolve_default_hdfs_service()
+
+    def test_hadoop_xml_parsing(self, tmp_path, monkeypatch):
+        conf_dir = tmp_path / 'etc' / 'hadoop'
+        conf_dir.mkdir(parents=True)
+        (conf_dir / 'core-site.xml').write_text(
+            '<configuration><property><name>fs.defaultFS</name>'
+            '<value>hdfs://ns</value></property></configuration>')
+        (conf_dir / 'hdfs-site.xml').write_text(
+            '<configuration>'
+            '<property><name>dfs.ha.namenodes.ns</name><value>a,b</value></property>'
+            '<property><name>dfs.namenode.rpc-address.ns.a</name><value>h1:8020</value></property>'
+            '<property><name>dfs.namenode.rpc-address.ns.b</name><value>h2:8020</value></property>'
+            '</configuration>')
+        monkeypatch.setenv('HADOOP_HOME', str(tmp_path))
+        r = HdfsNamenodeResolver()
+        assert r.resolve_default_hdfs_service() == ['ns', ['h1:8020', 'h2:8020']]
+
+
+class _FlakyFs(object):
+    """Fails N times then succeeds; records which 'namenode' served."""
+    def __init__(self, host, fail_first):
+        self.host = host
+        self._fail_first = fail_first
+
+    def ls(self, path):
+        if self._fail_first['remaining'] > 0:
+            self._fail_first['remaining'] -= 1
+            raise IOError('connection refused')
+        return ['{}:{}'.format(self.host, path)]
+
+
+class TestHAFailover:
+    def _client(self, fail_count):
+        state = {'remaining': fail_count}
+        return HAHdfsClient(lambda host: _FlakyFs(host, state),
+                            ['nn1:8020', 'nn2:8020'])
+
+    def test_failover_retries_next_namenode(self):
+        client = self._client(fail_count=1)
+        assert client.ls('/x') == ['nn2:8020:/x']
+
+    def test_exhausted_failovers_raise(self):
+        client = self._client(fail_count=10)
+        with pytest.raises(MaxFailoversExceeded):
+            client.ls('/x')
+
+
+class TestBatchingTableQueue:
+    def test_rechunks(self):
+        q = BatchingTableQueue(batch_size=4)
+        q.put(pa.table({'x': np.arange(3)}))
+        assert q.empty()
+        q.put(pa.table({'x': np.arange(3, 10)}))
+        assert not q.empty()
+        out = q.get()
+        np.testing.assert_array_equal(out.column('x').to_numpy(), [0, 1, 2, 3])
+        out2 = q.get()
+        np.testing.assert_array_equal(out2.column('x').to_numpy(), [4, 5, 6, 7])
+        assert q.empty()   # 2 rows left < 4
+
+    def test_record_batch_input(self):
+        q = BatchingTableQueue(batch_size=2)
+        q.put(pa.RecordBatch.from_pydict({'x': [1, 2, 3]}))
+        assert q.get().num_rows == 2
+
+    def test_get_on_empty_raises(self):
+        q = BatchingTableQueue(batch_size=2)
+        with pytest.raises(IndexError):
+            q.get()
+
+
+class TestFsIntegration:
+    def test_ha_nameservice_routes_through_ha_client(self, tmp_path, monkeypatch):
+        conf_dir = tmp_path / 'etc' / 'hadoop'
+        conf_dir.mkdir(parents=True)
+        (conf_dir / 'hdfs-site.xml').write_text(
+            '<configuration>'
+            '<property><name>dfs.ha.namenodes.ns1</name><value>a,b</value></property>'
+            '<property><name>dfs.namenode.rpc-address.ns1.a</name><value>h1:8020</value></property>'
+            '<property><name>dfs.namenode.rpc-address.ns1.b</name><value>h2:8020</value></property>'
+            '</configuration>')
+        monkeypatch.setenv('HADOOP_HOME', str(tmp_path))
+
+        from petastorm_tpu import fs as fs_mod
+        from petastorm_tpu.hdfs import namenode as nn_mod
+        assert fs_mod._resolve_hdfs_namenodes('hdfs://ns1/data') == \
+            ['h1:8020', 'h2:8020']
+        assert fs_mod._resolve_hdfs_namenodes('hdfs://host:8020/data') is None
+
+        sentinel = object()
+        captured = {}
+
+        def fake_connect(namenodes):
+            captured['namenodes'] = namenodes
+            return sentinel
+
+        monkeypatch.setattr(nn_mod.HdfsConnector, 'connect_to_either_namenode',
+                            staticmethod(fake_connect))
+        fs, path, factory = fs_mod.get_filesystem_and_path_or_paths('hdfs://ns1/data')
+        assert fs is sentinel
+        assert captured['namenodes'] == ['h1:8020', 'h2:8020']
+        assert path == '/data'
+        assert factory() is sentinel
