@@ -1,0 +1,88 @@
+"""Long-context LM training: NGram windowed reader → sharded transformer.
+
+This is the pipeline SURVEY §5.7 calls for: the NGram reader assembles
+fixed-length timestamped token windows (data-side sequence assembly), the
+JAX side trains a transformer LM whose parallelism (dp/sp/tp) is expressed
+through GSPMD shardings — ring attention carries the sequence dimension when
+the mesh has a 'seq' axis.
+"""
+
+import tempfile
+
+import numpy as np
+
+from petastorm_tpu import make_reader, materialize_dataset
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+TokenSchema = Unischema('TokenSchema', [
+    UnischemaField('step', np.int64, (), ScalarCodec(), False),
+    UnischemaField('tokens', np.int32, (64,), NdarrayCodec(), False),
+])
+
+
+def generate_token_stream(output_url, n_steps=512, vocab=128, seed=0):
+    """Each row is a 64-token chunk; consecutive rows continue the stream."""
+    rng = np.random.default_rng(seed)
+    with materialize_dataset(output_url, TokenSchema, rows_per_file=256,
+                             row_group_size_mb=64) as w:
+        w.write_rows({'step': np.int64(i),
+                      'tokens': rng.integers(0, vocab, 64, dtype=np.int32)}
+                     for i in range(n_steps))
+
+
+def train(dataset_url, steps=20, mesh=None):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from petastorm_tpu.models import transformer_lm as tlm
+
+    # window of 2 consecutive chunks -> (input window, continuation window)
+    ngram = NGram(fields={0: ['step', 'tokens'], 1: ['tokens']},
+                  delta_threshold=1, timestamp_field='step')
+    config = tlm.TransformerConfig(
+        vocab_size=128, d_model=128, n_heads=4, n_layers=2, d_ff=256,
+        max_seq_len=64,
+        attention='ring' if mesh is not None and 'seq' in mesh.axis_names
+        else 'blockwise')
+    params = tlm.init(jax.random.PRNGKey(0), config)
+    if mesh is not None:
+        pshard = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), tlm.param_specs(config, mesh),
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+        params = jax.tree_util.tree_map(jax.device_put, params, pshard)
+    optimizer, step_fn = tlm.make_train_step(config, mesh)
+    opt_state = optimizer.init(params)
+
+    losses = []
+    with make_reader(dataset_url, schema_fields=ngram, num_epochs=None,
+                     shuffle_row_groups=False) as reader:
+        window_batch = []
+        for window in reader:
+            window_batch.append(window)
+            if len(window_batch) < 8:
+                continue
+            tokens = jnp.stack([jnp.asarray(w[0].tokens) for w in window_batch])
+            # next-token targets: shift within the window, next chunk's first
+            # token closes the gap — exact continuation thanks to NGram
+            nxt = jnp.stack([jnp.asarray(w[1].tokens[0]) for w in window_batch])
+            targets = jnp.concatenate([tokens[:, 1:], nxt[:, None]], axis=1)
+            if mesh is not None:
+                bshard = NamedSharding(mesh, tlm.batch_spec(mesh))
+                tokens = jax.device_put(tokens, bshard)
+                targets = jax.device_put(targets, bshard)
+            params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+            losses.append(float(loss))
+            window_batch = []
+            if len(losses) >= steps:
+                break
+    print('first loss {:.3f} -> last loss {:.3f}'.format(losses[0], losses[-1]))
+    return losses
+
+
+if __name__ == '__main__':
+    url = 'file://' + tempfile.mkdtemp() + '/tokens'
+    generate_token_stream(url)
+    train(url)

@@ -1,0 +1,93 @@
+"""Infeed/compute overlap measurement — the TPU north-star metric
+(BASELINE.json: samples/sec/chip + infeed-stall %, target ≥90% overlap).
+
+For each training step we split wall time into *stall* (waiting on the input
+pipeline for the next batch) and *compute* (device busy in the step function).
+``overlap = compute / (compute + stall)``: 1.0 means the pipeline always had a
+batch staged when the device finished, i.e. infeed fully hidden behind
+compute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Iterable, Optional
+
+
+@dataclasses.dataclass
+class InfeedReport:
+    steps: int
+    samples: int
+    total_time_s: float
+    stall_time_s: float
+    compute_time_s: float
+
+    @property
+    def overlap(self) -> float:
+        busy = self.compute_time_s + self.stall_time_s
+        return self.compute_time_s / busy if busy else 1.0
+
+    @property
+    def stall_fraction(self) -> float:
+        return 1.0 - self.overlap
+
+    @property
+    def samples_per_sec(self) -> float:
+        return self.samples / self.total_time_s if self.total_time_s else 0.0
+
+    def as_dict(self):
+        return {'steps': self.steps, 'samples': self.samples,
+                'samples_per_sec': round(self.samples_per_sec, 2),
+                'infeed_stall_pct': round(100.0 * self.stall_fraction, 2),
+                'overlap_pct': round(100.0 * self.overlap, 2)}
+
+
+def measure_infeed_overlap(batch_iterator: Iterable, step_fn: Callable,
+                           num_steps: int = 100, warmup_steps: int = 5,
+                           count_fn: Optional[Callable] = None) -> InfeedReport:
+    """Drive ``step_fn(batch)`` over ``batch_iterator`` and time stalls.
+
+    :param step_fn: one training/inference step; its result is blocked on
+        (``jax.block_until_ready``) so compute time is real device time.
+    :param count_fn: ``batch -> int`` sample counter (default: len of the
+        first value of a dict batch / first field of a tuple).
+    """
+    import jax
+
+    iterator = iter(batch_iterator)
+
+    def batch_size_of(batch):
+        if count_fn is not None:
+            return count_fn(batch)
+        if isinstance(batch, dict):
+            first = next(v for k, v in batch.items() if k != '_host')
+        else:
+            first = batch[0]
+        return int(first.shape[0])
+
+    for _ in range(warmup_steps):
+        out = step_fn(next(iterator))
+        jax.block_until_ready(out)
+
+    stall = compute = 0.0
+    samples = 0
+    steps = 0
+    start = time.perf_counter()
+    for _ in range(num_steps):
+        t0 = time.perf_counter()
+        try:
+            batch = next(iterator)
+        except StopIteration:
+            break
+        t1 = time.perf_counter()
+        out = step_fn(batch)
+        jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        stall += t1 - t0
+        compute += t2 - t1
+        samples += batch_size_of(batch)
+        steps += 1
+    total = time.perf_counter() - start
+    return InfeedReport(steps=steps, samples=samples, total_time_s=total,
+                        stall_time_s=stall, compute_time_s=compute)

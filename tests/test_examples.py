@@ -1,0 +1,50 @@
+"""Example smoke tests (reference ``examples/*/tests``)."""
+
+import sys
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def examples_on_path(monkeypatch):
+    import os
+    root = os.path.join(os.path.dirname(__file__), '..')
+    monkeypatch.syspath_prepend(root)
+
+
+class TestHelloWorld:
+    def test_generate_and_read(self, tmp_path, capsys):
+        from examples.hello_world.main import (generate_petastorm_tpu_dataset,
+                                               jax_hello_world,
+                                               python_hello_world)
+        url = 'file://' + str(tmp_path / 'hw')
+        generate_petastorm_tpu_dataset(url, rows_count=4)
+        python_hello_world(url)
+        jax_hello_world(url)
+        out = capsys.readouterr().out
+        assert '(128, 256, 3)' in out
+        assert 'batch of' in out
+
+    def test_external_dataset(self, non_petastorm_dataset, capsys):
+        from examples.hello_world.main import external_dataset_hello_world
+        external_dataset_hello_world(non_petastorm_dataset.url)
+        assert 'columns:' in capsys.readouterr().out
+
+
+class TestMnist:
+    def test_trains_to_high_accuracy(self, tmp_path):
+        from examples.mnist.main import generate_synthetic_mnist, train
+        url = 'file://' + str(tmp_path / 'mnist')
+        generate_synthetic_mnist(url, n=1024)
+        _, acc = train(url, epochs=3)
+        assert acc > 0.9, acc
+
+
+class TestTransformerLm:
+    def test_loss_decreases(self, tmp_path):
+        from examples.transformer_lm.main import generate_token_stream, train
+        url = 'file://' + str(tmp_path / 'tokens')
+        generate_token_stream(url, n_steps=256)
+        losses = train(url, steps=12)
+        assert losses[-1] < losses[0]
