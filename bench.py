@@ -1,14 +1,21 @@
 #!/usr/bin/env python
-"""Headline benchmark: reader throughput on the hello-world dataset, matching
-the reference's measurement protocol (``petastorm-throughput.py`` defaults:
-3 thread workers, 200 warmup samples, 1000 measured samples, row-granular
-reader — ``docs/benchmarks_tutorial.rst:20-21`` reports 709.84 samples/sec).
+"""Headline benchmark. Prints ONE JSON line:
+``{"metric", "value", "unit", "vs_baseline", "northstar": {...}}``.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- Primary metric: reader throughput on the hello-world dataset, protocol-
+  matched to the reference (``petastorm-throughput.py`` defaults: 3 thread
+  workers, 200 warmup, 1000 measured samples —
+  ``docs/benchmarks_tutorial.rst:20-21`` reports 709.84 samples/sec).
+- ``northstar``: the BASELINE.md target metric — samples/sec/chip +
+  infeed-stall % of real train steps (MLP on png images, transformer LM on
+  token windows) fed through make_reader -> JaxDataLoader ->
+  prefetch_to_device, on the TPU when one is usable (CPU fallback flagged
+  via ``platform``).
 """
 
 import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -16,15 +23,46 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_SAMPLES_PER_SEC = 709.84   # reference docs/benchmarks_tutorial.rst:20-21
 
 DATASET_PATH = '/tmp/petastorm_tpu_hello_world_bench'
+MNIST_PATH = '/tmp/petastorm_tpu_northstar_mnist'
+TOKENS_PATH = '/tmp/petastorm_tpu_northstar_tokens'
+
+
+def _probe_platform():
+    """The ambient jax backend's platform name ('tpu', 'gpu', ...) if it
+    initializes cleanly, else 'cpu' (forced via env BEFORE this process
+    imports jax). Probing in a throwaway subprocess keeps a broken TPU
+    runtime (e.g. libtpu version mismatch) from poisoning the bench
+    process."""
+    try:
+        out = subprocess.run(
+            [sys.executable, '-c',
+             'import jax; d = jax.devices(); print(d[0].platform)'],
+            env=dict(os.environ), capture_output=True, timeout=180)
+        if out.returncode == 0:
+            platform = out.stdout.decode().strip().splitlines()[-1]
+            if platform:
+                return platform
+    except Exception:
+        pass
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    return 'cpu'
+
+
+def _ensure(path, marker, generate):
+    if not os.path.exists(os.path.join(path, marker)):
+        generate()
 
 
 def main():
+    platform = _probe_platform()
+
+    from petastorm_tpu.benchmark import northstar
     from petastorm_tpu.benchmark.hello_world import generate_hello_world_dataset
     from petastorm_tpu.benchmark.throughput import reader_throughput
 
     url = 'file://' + DATASET_PATH
-    if not os.path.exists(os.path.join(DATASET_PATH, '_common_metadata')):
-        generate_hello_world_dataset(url, rows_count=10)
+    _ensure(DATASET_PATH, '_common_metadata',
+            lambda: generate_hello_world_dataset(url, rows_count=10))
 
     best = 0.0
     for _ in range(3):   # best-of-3 to damp host noise
@@ -33,11 +71,54 @@ def main():
                                    read_method='python')
         best = max(best, result.samples_per_sec)
 
+    # -- north-star: train-step infeed overlap ------------------------------
+    # Accelerator-scale configs for any non-CPU backend; dataset paths carry
+    # the size parameters so a platform change can't reuse a stale store.
+    on_tpu = platform != 'cpu'
+    mnist_rows = 16384 if on_tpu else 2048
+    mnist_batch = 512 if on_tpu else 128
+    seq_len = 256 if on_tpu else 128
+    mnist_path = '{}_{}'.format(MNIST_PATH, mnist_rows)
+    tokens_rows = 2048 if on_tpu else 512
+    tokens_path = '{}_{}x{}'.format(TOKENS_PATH, tokens_rows, seq_len)
+    mnist_url = 'file://' + mnist_path
+    tokens_url = 'file://' + tokens_path
+    _ensure(mnist_path, '_common_metadata',
+            lambda: northstar.generate_mnist_images_dataset(
+                mnist_url, rows=mnist_rows))
+    _ensure(tokens_path, '_common_metadata',
+            lambda: northstar.generate_token_dataset(
+                tokens_url, rows=tokens_rows, seq_len=seq_len))
+
+    if on_tpu:
+        mnist = northstar.run_mnist_train_bench(
+            mnist_url, batch_size=mnist_batch, num_steps=60, hidden=2048)
+        mnist_cached = northstar.run_mnist_cached_train_bench(
+            mnist_url, rows=mnist_rows, batch_size=mnist_batch, num_steps=60,
+            hidden=2048)
+        lm = northstar.run_transformer_train_bench(
+            tokens_url, batch_size=64, num_steps=40, seq_len=seq_len)
+    else:
+        mnist = northstar.run_mnist_train_bench(
+            mnist_url, batch_size=mnist_batch, num_steps=15, hidden=256)
+        mnist_cached = northstar.run_mnist_cached_train_bench(
+            mnist_url, rows=mnist_rows, batch_size=mnist_batch, num_steps=15,
+            hidden=256)
+        lm = northstar.run_transformer_train_bench(
+            tokens_url, batch_size=8, num_steps=8, seq_len=seq_len,
+            d_model=128, n_layers=2, d_ff=512)
+
     print(json.dumps({
         'metric': 'hello_world_reader_throughput',
         'value': round(best, 2),
         'unit': 'samples/sec',
         'vs_baseline': round(best / BASELINE_SAMPLES_PER_SEC, 3),
+        'northstar': {
+            'platform': platform,
+            'mnist_train': mnist.as_dict(),
+            'mnist_train_cached': mnist_cached.as_dict(),
+            'transformer_train': lm.as_dict(),
+        },
     }))
 
 

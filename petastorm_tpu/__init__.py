@@ -10,14 +10,15 @@ __version__ = '0.1.0'
 from petastorm_tpu.errors import NoDataAvailableError  # noqa: F401
 from petastorm_tpu.transform import TransformSpec  # noqa: F401
 
-__all__ = ['make_reader', 'make_batch_reader', 'TransformSpec', 'NoDataAvailableError',
+__all__ = ['make_reader', 'make_batch_reader', 'make_columnar_reader',
+           'TransformSpec', 'NoDataAvailableError',
            'make_jax_loader', 'make_dataset_converter', 'materialize_dataset',
            '__version__']
 
 
 def __getattr__(name):
     # Lazy imports keep `import petastorm_tpu` light and avoid import cycles.
-    if name in ('make_reader', 'make_batch_reader'):
+    if name in ('make_reader', 'make_batch_reader', 'make_columnar_reader'):
         from petastorm_tpu import reader
         return getattr(reader, name)
     if name == 'make_jax_loader':

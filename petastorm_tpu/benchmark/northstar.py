@@ -1,0 +1,196 @@
+"""North-star benchmark: **samples/sec/chip + infeed-stall %** on real train
+steps fed from Parquet (BASELINE.md target: >=90% infeed/compute overlap).
+
+Two workloads, both driven through the full production path
+``make_reader -> JaxDataLoader -> prefetch_to_device -> jitted train step``:
+
+- ``mnist``: png-compressed 28x28 images decoded by the worker pool, feeding
+  an MLP classifier — the decode-heavy regime where infeed stalls live.
+- ``transformer``: int32 token windows (the NGram-style LM pipeline shape)
+  feeding the flagship transformer LM — the compute-heavy regime where the
+  pipeline must simply keep up.
+
+Measurement protocol is the reference's warmup+measure cycle structure
+(``/root/reference/petastorm/benchmark/throughput.py:112-172``) extended with
+device-side stall accounting (``petastorm_tpu/benchmark/infeed.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from petastorm_tpu.benchmark.infeed import InfeedReport, measure_infeed_overlap
+from petastorm_tpu.codecs import ArrowListCodec, CompressedImageCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+MnistImageSchema = Unischema('MnistImageSchema', [
+    UnischemaField('idx', np.int64, (), ScalarCodec(), False),
+    UnischemaField('image', np.uint8, (28, 28), CompressedImageCodec('png'), False),
+    UnischemaField('label', np.int64, (), ScalarCodec(), False),
+])
+
+
+def generate_mnist_images_dataset(output_url: str, rows: int = 16384,
+                                  seed: int = 0,
+                                  row_group_size_mb: float = 0.5) -> str:
+    """Synthetic MNIST-shaped dataset: png images + labels.
+
+    Small row groups by default: a row group is the unit of worker
+    parallelism, and tiny-png groups must outnumber the decode workers."""
+    rng = np.random.default_rng(seed)
+
+    def gen():
+        for i in range(rows):
+            yield {'idx': np.int64(i),
+                   'image': rng.integers(0, 255, size=(28, 28), dtype=np.uint8),
+                   'label': np.int64(i % 10)}
+
+    with materialize_dataset(output_url, MnistImageSchema,
+                             row_group_size_mb=row_group_size_mb) as writer:
+        writer.write_rows(gen())
+    return output_url
+
+
+def make_token_schema(seq_len: int) -> Unischema:
+    # arrow_list: token windows decode vectorized in C++ (no per-row np.load)
+    return Unischema('TokenSchema', [
+        UnischemaField('tokens', np.int32, (seq_len + 1,), ArrowListCodec(), False),
+    ])
+
+
+def generate_token_dataset(output_url: str, rows: int = 2048,
+                           seq_len: int = 256, vocab: int = 8192,
+                           seed: int = 0) -> str:
+    """LM token windows: each row holds seq_len+1 tokens (input + shifted
+    target), the shape the NGram pipeline emits for next-token training."""
+    rng = np.random.default_rng(seed)
+    schema = make_token_schema(seq_len)
+
+    def gen():
+        for _ in range(rows):
+            yield {'tokens': rng.integers(0, vocab, size=(seq_len + 1,),
+                                          dtype=np.int32)}
+
+    with materialize_dataset(output_url, schema, row_group_size_mb=4) as writer:
+        writer.write_rows(gen())
+    return output_url
+
+
+def _default_workers() -> int:
+    import os
+    return min(8, max(2, os.cpu_count() or 2))
+
+
+def _make_mnist_step(hidden: int):
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.models import mnist_mlp
+
+    params = mnist_mlp.init(jax.random.PRNGKey(0), hidden=hidden)
+
+    @jax.jit
+    def step(params, images_u8, labels):
+        images = images_u8.reshape(images_u8.shape[0], -1).astype(jnp.float32) / 255.0
+        loss, grads = jax.value_and_grad(mnist_mlp.loss_fn)(params, images, labels)
+        params = jax.tree_util.tree_map(lambda p, g: p - 1e-3 * g, params, grads)
+        return params, loss
+
+    state = {'params': params}
+
+    def step_fn(batch):
+        state['params'], loss = step(state['params'], batch['image'],
+                                     batch['label'])
+        return loss
+
+    return step_fn
+
+
+def run_mnist_train_bench(dataset_url: str, batch_size: int = 512,
+                          num_steps: int = 60, warmup_steps: int = 5,
+                          workers_count: int = None, hidden: int = 2048,
+                          prefetch: int = 4) -> InfeedReport:
+    """Train the MLP from parquet png images, decoding every epoch from disk;
+    report overlap + samples/sec (the decode-bound regime)."""
+    from petastorm_tpu import make_columnar_reader
+    from petastorm_tpu.jax_utils import JaxDataLoader, prefetch_to_device
+
+    step_fn = _make_mnist_step(hidden)
+    with make_columnar_reader(dataset_url, reader_pool_type='thread',
+                              workers_count=workers_count or _default_workers(),
+                              num_epochs=None) as reader:
+        loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
+        batches = prefetch_to_device(iter(loader), size=prefetch)
+        return measure_infeed_overlap(
+            batches, step_fn, num_steps=num_steps, warmup_steps=warmup_steps,
+            count_fn=lambda b: int(b['label'].shape[0]))
+
+
+def run_mnist_cached_train_bench(dataset_url: str, rows: int,
+                                 batch_size: int = 512,
+                                 num_steps: int = 60,
+                                 workers_count: int = None,
+                                 hidden: int = 2048,
+                                 prefetch: int = 4) -> InfeedReport:
+    """Steady-state epochs with the device-side epoch cache: epoch 1 decodes
+    from parquet and stages every batch into HBM; epochs 2+ replay the device
+    arrays with zero host work (``jax_utils.epoch_cache_on_device``, the
+    device-side upgrade of the reference's
+    ``BatchedDataLoader(inmemory_cache_all=True)``, ``pytorch.py:292-321``).
+    Warmup spans the whole first epoch so the measured window is pure steady
+    state."""
+    from petastorm_tpu import make_columnar_reader
+    from petastorm_tpu.jax_utils import JaxDataLoader, epoch_cache_on_device
+
+    step_fn = _make_mnist_step(hidden)
+    with make_columnar_reader(dataset_url, reader_pool_type='thread',
+                              workers_count=workers_count or _default_workers(),
+                              num_epochs=1) as reader:
+        loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
+        # Warmup must span the entire cache-fill epoch (plus compile steps) so
+        # the measured window replays device arrays only.
+        steps_per_epoch = max(1, rows // batch_size)
+        batches = epoch_cache_on_device(loader)
+        return measure_infeed_overlap(
+            batches, step_fn, num_steps=num_steps,
+            warmup_steps=steps_per_epoch + 2,
+            count_fn=lambda b: int(b['label'].shape[0]))
+
+
+def run_transformer_train_bench(dataset_url: str, batch_size: int = 64,
+                                num_steps: int = 40, warmup_steps: int = 3,
+                                workers_count: int = None, prefetch: int = 4,
+                                d_model: int = 256, n_layers: int = 4,
+                                n_heads: int = 8, d_ff: int = 1024,
+                                seq_len: int = 256,
+                                vocab: int = 8192) -> InfeedReport:
+    """Train the flagship LM from parquet token windows."""
+    import jax
+
+    from petastorm_tpu import make_columnar_reader
+    from petastorm_tpu.jax_utils import JaxDataLoader, prefetch_to_device
+    from petastorm_tpu.models import transformer_lm as tlm
+
+    config = tlm.TransformerConfig(vocab_size=vocab, d_model=d_model,
+                                   n_heads=n_heads, n_layers=n_layers,
+                                   d_ff=d_ff, max_seq_len=seq_len)
+    params = tlm.init(jax.random.PRNGKey(0), config)
+    optimizer, step = tlm.make_train_step(config)
+    opt_state = optimizer.init(params)
+    state = {'params': params, 'opt': opt_state}
+
+    def step_fn(batch):
+        tokens = batch['tokens']
+        state['params'], state['opt'], loss = step(
+            state['params'], state['opt'], tokens[:, :-1], tokens[:, 1:])
+        return loss
+
+    with make_columnar_reader(dataset_url, reader_pool_type='thread',
+                              workers_count=workers_count or _default_workers(),
+                              num_epochs=None) as reader:
+        loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
+        batches = prefetch_to_device(iter(loader), size=prefetch)
+        return measure_infeed_overlap(
+            batches, step_fn, num_steps=num_steps, warmup_steps=warmup_steps,
+            count_fn=lambda b: int(b['tokens'].shape[0]))

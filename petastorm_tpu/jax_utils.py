@@ -324,6 +324,42 @@ def make_jax_loader(reader, batch_size=1, mesh=None, batch_axis='data',
                             inmemory_cache_all=inmemory_cache_all)
 
 
+def epoch_cache_on_device(loader, sharding=None):
+    """Iterate epochs forever, caching epoch 1 **on device**.
+
+    Epoch 1 stages each batch into HBM (``jax.device_put``) and keeps the
+    device arrays; epochs 2+ replay them with zero host work and zero
+    transfers — infeed disappears entirely for datasets that fit in device
+    memory (the device-side upgrade of the reference's host-side
+    ``inmemory_cache_all``, ``pytorch.py:292-321``). Host-only columns
+    (``_host`` or string/object arrays) are kept on host, untouched.
+
+    :param loader: an iterable yielding batch dicts; re-iterated never (the
+        cached epoch is replayed instead).
+    :param sharding: optional ``jax.sharding.Sharding`` for the device copies.
+    """
+    import jax
+
+    def stage(batch):
+        def put(x):
+            if not _is_device_compatible(x):
+                return x
+            return jax.device_put(x, sharding) if sharding is not None \
+                else jax.device_put(x)
+        return jax.tree_util.tree_map(put, batch)
+
+    cache = []
+    for batch in loader:
+        staged = stage(batch)
+        cache.append(staged)
+        yield staged
+    if not cache:
+        return
+    while True:
+        for batch in cache:
+            yield batch
+
+
 def prefetch_to_device(iterator, size=2, sharding=None):
     """Double-buffered host→device prefetch.
 

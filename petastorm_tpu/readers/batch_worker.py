@@ -9,15 +9,13 @@ results-queue reader converting Table -> numpy dict (:38-87).
 
 from __future__ import annotations
 
-import hashlib
-from typing import Dict, List
+from typing import List
 
 import numpy as np
 import pyarrow as pa
-import pyarrow.parquet as pq
 
+from petastorm_tpu.readers.piece_worker import ParquetPieceWorker
 from petastorm_tpu.utils import cast_partition_value
-from petastorm_tpu.workers.worker_base import WorkerBase
 
 
 class BatchResultsReader:
@@ -47,43 +45,18 @@ class BatchResultsReader:
     def _column_to_numpy(column: pa.ChunkedArray, field) -> np.ndarray:
         list_like = pa.types.is_list(column.type) or pa.types.is_large_list(column.type)
         if list_like:
-            rows = column.to_pylist()
-            shape = field.shape
-            if shape and all(s is not None for s in shape):
-                # fixed-shape: vstack into (n, *shape) (reference :66-77)
-                return np.asarray(rows).reshape((len(rows),) + tuple(shape))
-            out = np.empty(len(rows), dtype=object)
-            for i, r in enumerate(rows):
-                out[i] = np.asarray(r)
-            return out
+            # fixed-shape numeric lists flatten in C++ (reference vstacks
+            # python lists, :66-77)
+            from petastorm_tpu.readers.columnar_worker import _list_column_to_numpy
+            return _list_column_to_numpy(column, field)
         if pa.types.is_string(column.type) or pa.types.is_large_string(column.type) \
                 or pa.types.is_binary(column.type) or pa.types.is_large_binary(column.type):
             return np.asarray(column.to_pylist(), dtype=object)
         return column.to_numpy(zero_copy_only=False)
 
 
-class ArrowBatchWorker(WorkerBase):
+class ArrowBatchWorker(ParquetPieceWorker):
     """Processes ventilated items into published ``pa.Table`` batches."""
-
-    def __init__(self, worker_id, publish_func, args):
-        super().__init__(worker_id, publish_func, args)
-        self._filesystem = args['filesystem_factory']()
-        self._dataset_path = args['dataset_path']
-        self._schema = args['schema']
-        self._split_pieces = args['split_pieces']
-        self._local_cache = args['local_cache']
-        self._transform_spec = args['transform_spec']
-        self._transformed_schema = args['transformed_schema']
-        self._open_files: Dict[str, pq.ParquetFile] = {}
-
-    def shutdown(self):
-        for f in self._open_files.values():
-            f.close()
-
-    def _parquet_file(self, path: str) -> pq.ParquetFile:
-        if path not in self._open_files:
-            self._open_files[path] = pq.ParquetFile(self._filesystem.open(path, 'rb'))
-        return self._open_files[path]
 
     def process(self, piece_index: int, worker_predicate=None,
                 shuffle_row_drop_partition=(0, 1)):
@@ -91,9 +64,7 @@ class ArrowBatchWorker(WorkerBase):
         if worker_predicate is not None:
             table = self._load_table_with_predicate(piece, worker_predicate)
         else:
-            cache_key = 'batch:{}:{}:{}'.format(
-                hashlib.md5(str(self._dataset_path).encode()).hexdigest(), piece.path,
-                piece.row_group)
+            cache_key = self._cache_key('batch', piece)
             table = self._local_cache.get(cache_key, lambda: self._load_table(piece))
         if table is None or table.num_rows == 0:
             return
@@ -108,10 +79,6 @@ class ArrowBatchWorker(WorkerBase):
             self.publish_func(table)
 
     # -- loading ---------------------------------------------------------------
-
-    def _stored_columns(self, names: List[str], piece) -> List[str]:
-        partition_keys = set(piece.partition_dict.keys())
-        return [n for n in names if n not in partition_keys]
 
     def _append_partition_columns(self, table: pa.Table, piece) -> pa.Table:
         for key, value in piece.partition_dict.items():

@@ -1,0 +1,229 @@
+"""Columnar decoded reader worker: the vectorized path for petastorm_tpu
+(codec) datasets.
+
+The reference forces codec datasets through a per-row path
+(``petastorm/py_dict_reader_worker.py``: ``to_pylist`` -> per-row dict ->
+``decode_row`` -> namedtuple), which caps Python-side throughput at tens of
+thousands of rows/sec. TPU batches are columnar, so this worker decodes a row
+group **column-wise**: scalar columns convert via ``Table.to_numpy`` (no
+Python per row), codec columns decode cell-by-cell straight into one
+preallocated ``(n, *shape)`` array, and the consumer receives a dict of
+column arrays with zero per-row Python work. No reference analogue — this
+path exists because the JAX adapter wants exactly this layout.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.readers.piece_worker import ParquetPieceWorker
+from petastorm_tpu.utils import cast_partition_value
+
+
+class ColumnarResultsReader:
+    """Consumer-side: published dict of column arrays -> batch namedtuple
+    (``batched_output=True``)."""
+
+    def __init__(self, schema, ngram=None):
+        assert ngram is None, 'NGram is not supported by the columnar reader'
+        self._schema = schema
+
+    @property
+    def batched_output(self) -> bool:
+        return True
+
+    def read_next(self, pool):
+        columns = pool.get_results()
+        return self._schema.make_batch_namedtuple(**columns)
+
+
+def _decode_binary_column(column: pa.ChunkedArray, field) -> np.ndarray:
+    """Decode a codec-encoded binary column into (n, *shape) (fixed shapes)
+    or an object array (wildcard shapes, null cells, non-ndarray payloads)."""
+    codec = field.codec
+    raw = column.to_pylist()
+    n = len(raw)
+    fixed = field.shape is not None and all(s is not None for s in field.shape)
+    if not n:
+        if fixed:
+            return np.empty((0,) + tuple(field.shape), dtype=field.numpy_dtype)
+        return np.empty(0, dtype=object)
+    decode = lambda cell: None if cell is None else codec.decode(field, cell)  # noqa: E731
+    if fixed and column.null_count == 0:
+        first = decode(raw[0])
+        if isinstance(first, np.ndarray):
+            out = np.empty((n,) + first.shape, dtype=first.dtype)
+            out[0] = first
+            for i in range(1, n):
+                out[i] = decode(raw[i])
+            return out
+        # non-ndarray payload (e.g. a bytes ScalarCodec): object column below,
+        # with the already-decoded first element reused
+        out = np.empty(n, dtype=object)
+        out[0] = first
+        for i in range(1, n):
+            out[i] = decode(raw[i])
+        return out
+    # nulls present or wildcard shape: dense packing impossible
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = decode(raw[i])
+    return out
+
+
+def _list_column_to_numpy(column: pa.ChunkedArray, field) -> np.ndarray:
+    """List column -> numpy. Fixed-shape numeric lists take the zero-Python
+    path: flatten the arrow values buffer in C++ and reshape."""
+    shape = tuple(field.shape) if field.shape else ()
+    fixed = shape and all(s is not None for s in shape)
+    if fixed and column.null_count == 0:
+        arr = column.combine_chunks()
+        flat = arr.flatten().to_numpy(zero_copy_only=False)
+        if field.numpy_dtype is not None:
+            target = np.dtype(field.numpy_dtype)
+            if flat.dtype != target and flat.dtype.kind in 'biuf':
+                flat = flat.astype(target)
+        expected = len(arr) * int(np.prod(shape))
+        if flat.size == expected:
+            return flat.reshape((len(arr),) + shape)
+        # ragged data under a fixed-shape schema: fall through to python path
+    rows = column.to_pylist()
+    if fixed:
+        return np.asarray(rows, dtype=field.numpy_dtype).reshape(
+            (len(rows),) + shape)
+    out = np.empty(len(rows), dtype=object)
+    for i, r in enumerate(rows):
+        out[i] = np.asarray(r)
+    return out
+
+
+def _column_to_numpy(column: pa.ChunkedArray, field) -> np.ndarray:
+    """Decoded numpy column for any unischema field."""
+    if field.codec is not None and (
+            pa.types.is_binary(column.type) or pa.types.is_large_binary(column.type)):
+        return _decode_binary_column(column, field)
+    if pa.types.is_list(column.type) or pa.types.is_large_list(column.type):
+        return _list_column_to_numpy(column, field)
+    if pa.types.is_string(column.type) or pa.types.is_large_string(column.type):
+        return np.asarray(column.to_pylist(), dtype=object)
+    arr = column.to_numpy(zero_copy_only=False)
+    if field.numpy_dtype is not None and not field.shape:
+        try:
+            target = np.dtype(field.numpy_dtype)
+        except TypeError:
+            return arr
+        if arr.dtype != target and arr.dtype.kind not in ('O', 'U', 'S'):
+            arr = arr.astype(target)
+    return arr
+
+
+class ColumnarWorker(ParquetPieceWorker):
+    """Processes ventilated items into published dicts of decoded numpy
+    column arrays."""
+
+    def process(self, piece_index: int, worker_predicate=None,
+                shuffle_row_drop_partition=(0, 1)):
+        piece = self._split_pieces[piece_index]
+        if worker_predicate is not None:
+            columns = self._load_with_predicate(piece, worker_predicate)
+        else:
+            cache_key = self._cache_key('columnar', piece)
+            columns = self._local_cache.get(cache_key, lambda: self._load(piece))
+        if columns is None:
+            return
+        n = len(next(iter(columns.values()))) if columns else 0
+        if not n:
+            return
+        partition, num_partitions = shuffle_row_drop_partition
+        if num_partitions > 1:
+            bounds = np.linspace(0, n, num_partitions + 1, dtype=int)
+            lo, hi = bounds[partition], bounds[partition + 1]
+            columns = {k: v[lo:hi] for k, v in columns.items()}
+            if hi <= lo:
+                return
+        if self._transform_spec is not None:
+            columns = self._apply_transform(columns)
+            if not columns or not len(next(iter(columns.values()))):
+                return
+        self.publish_func(columns)
+
+    # -- loading ---------------------------------------------------------------
+
+    def _partition_columns(self, piece, n: int, names) -> Dict[str, np.ndarray]:
+        out = {}
+        for key, value in piece.partition_dict.items():
+            if key in names:
+                field = self._full_schema.fields.get(key)
+                typed = cast_partition_value(
+                    field.numpy_dtype if field is not None else None, value)
+                if isinstance(typed, str):
+                    col = np.empty(n, dtype=object)
+                    col[:] = typed
+                else:
+                    col = np.full(n, typed)
+                out[key] = col
+        return out
+
+    def _decode_table(self, table: pa.Table, names) -> Dict[str, np.ndarray]:
+        out = {}
+        for name in names:
+            if name not in table.column_names:
+                continue
+            field = self._full_schema.fields[name]
+            out[name] = _column_to_numpy(table.column(name), field)
+        return out
+
+    def _load(self, piece) -> Dict[str, np.ndarray]:
+        names = list(self._schema.fields.keys())
+        table = self._parquet_file(piece.path).read_row_group(
+            piece.row_group, columns=self._stored_columns(names, piece))
+        columns = self._decode_table(table, names)
+        columns.update(self._partition_columns(piece, table.num_rows, set(names)))
+        return columns
+
+    def _load_with_predicate(self, piece, predicate) -> Optional[Dict[str, np.ndarray]]:
+        """Decode predicate columns first; decode the remaining columns only at
+        matching indices (cheaper than the row path, which decodes entire
+        predicate rows eagerly)."""
+        predicate_fields = list(predicate.get_fields())
+        unknown = set(predicate_fields) - set(self._full_schema.fields.keys())
+        if unknown:
+            raise ValueError('Predicate uses unknown fields: {}'.format(sorted(unknown)))
+        pf = self._parquet_file(piece.path)
+        pred_table = pf.read_row_group(
+            piece.row_group, columns=self._stored_columns(predicate_fields, piece))
+        pred_cols = self._decode_table(pred_table, predicate_fields)
+        pred_cols.update(self._partition_columns(
+            piece, pred_table.num_rows, set(predicate_fields)))
+        n = pred_table.num_rows
+        mask = np.fromiter(
+            (bool(predicate.do_include({f: pred_cols[f][i] for f in predicate_fields}))
+             for i in range(n)), dtype=bool, count=n)
+        if not mask.any():
+            return None
+        idx = np.nonzero(mask)[0]
+        out = {f: pred_cols[f][idx] for f in predicate_fields
+               if f in self._schema.fields}
+        other = [f for f in self._schema.fields if f not in set(predicate_fields)]
+        other_stored = self._stored_columns(other, piece)
+        if other_stored:
+            rest = pf.read_row_group(piece.row_group, columns=other_stored)
+            rest = rest.take(pa.array(idx))
+            out.update(self._decode_table(rest, other_stored))
+        out.update(self._partition_columns(piece, len(idx), set(other)))
+        return out
+
+    # -- transform -------------------------------------------------------------
+
+    def _apply_transform(self, columns: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """TransformSpec over a dict of column arrays (the columnar-path
+        contract; the row path hands ``func`` one row dict at a time, the arrow
+        batch path a pandas frame)."""
+        spec = self._transform_spec
+        if spec.func is not None:
+            columns = spec.func(columns)
+        return {name: columns[name] for name in self._transformed_schema.fields
+                if name in columns}

@@ -1,0 +1,46 @@
+"""Shared plumbing for workers that process one parquet row-group piece per
+ventilated item (file-handle cache, stored-column selection, cache keying)."""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List
+
+import pyarrow.parquet as pq
+
+from petastorm_tpu.workers.worker_base import WorkerBase
+
+
+class ParquetPieceWorker(WorkerBase):
+    """Base for row-group workers; subclasses implement :meth:`process`."""
+
+    def __init__(self, worker_id, publish_func, args):
+        super().__init__(worker_id, publish_func, args)
+        self._filesystem = args['filesystem_factory']()
+        self._dataset_path = args['dataset_path']
+        self._schema = args['schema']                  # output view
+        self._full_schema = args['full_schema']
+        self._split_pieces = args['split_pieces']
+        self._local_cache = args['local_cache']
+        self._transform_spec = args['transform_spec']
+        self._transformed_schema = args['transformed_schema']
+        self._open_files: Dict[str, pq.ParquetFile] = {}
+
+    def shutdown(self):
+        for f in self._open_files.values():
+            f.close()
+
+    def _parquet_file(self, path: str) -> pq.ParquetFile:
+        if path not in self._open_files:
+            self._open_files[path] = pq.ParquetFile(self._filesystem.open(path, 'rb'))
+        return self._open_files[path]
+
+    def _stored_columns(self, names: List[str], piece) -> List[str]:
+        """Columns to physically read: requested minus partition-derived."""
+        partition_keys = set(piece.partition_dict.keys())
+        return [n for n in names if n not in partition_keys]
+
+    def _cache_key(self, prefix: str, piece) -> str:
+        return '{}:{}:{}:{}'.format(
+            prefix, hashlib.md5(str(self._dataset_path).encode()).hexdigest(),
+            piece.path, piece.row_group)

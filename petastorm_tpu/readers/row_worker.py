@@ -10,16 +10,13 @@ partitioning incl. ngram continuation rows (:260-273), results-queue reader
 
 from __future__ import annotations
 
-import hashlib
-from typing import Dict, List, Optional
+from typing import List
 
 import numpy as np
-import pyarrow.parquet as pq
 
+from petastorm_tpu.readers.piece_worker import ParquetPieceWorker
 from petastorm_tpu.unischema import decode_row
 from petastorm_tpu.utils import cast_partition_value
-from petastorm_tpu.workers import EmptyResultError
-from petastorm_tpu.workers.worker_base import WorkerBase
 
 
 def _cast_partition_value(field, value: str):
@@ -49,31 +46,13 @@ class RowGroupResultsReader:
         return self._schema.make_namedtuple(**item)
 
 
-class RowGroupWorker(WorkerBase):
+class RowGroupWorker(ParquetPieceWorker):
     """Processes ventilated ``(piece_index, worker_predicate,
     shuffle_row_drop_partition)`` items."""
 
     def __init__(self, worker_id, publish_func, args):
         super().__init__(worker_id, publish_func, args)
-        self._filesystem = args['filesystem_factory']()
-        self._dataset_path = args['dataset_path']
-        self._schema = args['schema']                  # view used for output fields
-        self._full_schema = args['full_schema']        # complete stored schema
         self._ngram = args['ngram']
-        self._split_pieces = args['split_pieces']
-        self._local_cache = args['local_cache']
-        self._transform_spec = args['transform_spec']
-        self._transformed_schema = args['transformed_schema']
-        self._open_files: Dict[str, pq.ParquetFile] = {}
-
-    def shutdown(self):
-        for f in self._open_files.values():
-            f.close()
-
-    def _parquet_file(self, path: str) -> pq.ParquetFile:
-        if path not in self._open_files:
-            self._open_files[path] = pq.ParquetFile(self._filesystem.open(path, 'rb'))
-        return self._open_files[path]
 
     def process(self, piece_index: int, worker_predicate=None,
                 shuffle_row_drop_partition=(0, 1)):
@@ -81,7 +60,7 @@ class RowGroupWorker(WorkerBase):
         if worker_predicate is not None:
             rows = self._load_rows_with_predicate(piece, worker_predicate)
         else:
-            cache_key = self._cache_key(piece)
+            cache_key = self._cache_key('rowgroup', piece)
             rows = self._local_cache.get(cache_key, lambda: self._load_rows(piece))
         rows = self._drop_partition(rows, piece, *shuffle_row_drop_partition)
         if self._transform_spec is not None:
@@ -92,16 +71,6 @@ class RowGroupWorker(WorkerBase):
             self.publish_func(rows)
 
     # -- loading ---------------------------------------------------------------
-
-    def _cache_key(self, piece) -> str:
-        return 'rowgroup:{}:{}:{}'.format(
-            hashlib.md5(str(self._dataset_path).encode()).hexdigest(), piece.path, piece.row_group)
-
-    def _storage_columns(self, field_names, piece) -> List[str]:
-        """Columns to physically read: requested fields minus partition-derived."""
-        partition_keys = set(piece.partition_dict.keys())
-        stored = [n for n in field_names if n not in partition_keys]
-        return stored
 
     def _read_columns(self, piece, columns: List[str]):
         pf = self._parquet_file(piece.path)
@@ -124,7 +93,7 @@ class RowGroupWorker(WorkerBase):
                            if n in self._schema.fields or n in self._full_schema.fields]
         else:
             field_names = list(self._schema.fields.keys())
-        table = self._read_columns(piece, self._storage_columns(field_names, piece))
+        table = self._read_columns(piece, self._stored_columns(field_names, piece))
         # Decode against the full schema so predicate/ngram-only fields decode too.
         return self._decode_with_partitions(table.to_pylist(), piece, self._full_schema)
 
@@ -136,7 +105,7 @@ class RowGroupWorker(WorkerBase):
         if unknown:
             raise ValueError('Predicate uses unknown fields: {}'.format(sorted(unknown)))
         predicate_table = self._read_columns(
-            piece, self._storage_columns(predicate_fields, piece))
+            piece, self._stored_columns(predicate_fields, piece))
         predicate_rows = self._decode_with_partitions(
             predicate_table.to_pylist(), piece, self._full_schema)
         match_indices = [i for i, row in enumerate(predicate_rows)
@@ -146,7 +115,7 @@ class RowGroupWorker(WorkerBase):
         other_fields = [n for n in self._schema.fields.keys() if n not in predicate_fields]
         if other_fields:
             other_table = self._read_columns(
-                piece, self._storage_columns(other_fields, piece)).take(match_indices)
+                piece, self._stored_columns(other_fields, piece)).take(match_indices)
             other_rows = self._decode_with_partitions(
                 other_table.to_pylist(), piece, self._full_schema)
         else:

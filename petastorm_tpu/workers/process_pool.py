@@ -29,7 +29,7 @@ from petastorm_tpu.workers.serializers import PickleSerializer
 
 logger = logging.getLogger(__name__)
 
-_STARTUP_TIMEOUT_S = 20
+_STARTUP_TIMEOUT_S = 60
 _SHUTDOWN_TIMEOUT_S = 10
 _LOCALHOST = 'tcp://127.0.0.1'
 

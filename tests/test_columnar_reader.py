@@ -1,0 +1,202 @@
+"""Tests for the vectorized columnar decode path (``make_columnar_reader``),
+the ``ArrowListCodec``, and the device-side epoch cache."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_columnar_reader, make_reader
+from petastorm_tpu.codecs import ArrowListCodec, CompressedImageCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+from petastorm_tpu.predicates import in_lambda
+from petastorm_tpu.transform import TransformSpec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+ColumnarSchema = Unischema('ColumnarSchema', [
+    UnischemaField('idx', np.int64, (), ScalarCodec(), False),
+    UnischemaField('image', np.uint8, (12, 16), CompressedImageCodec('png'), False),
+    UnischemaField('vec', np.int32, (9,), ArrowListCodec(), False),
+    UnischemaField('mat', np.float32, (3, 4), ArrowListCodec(), False),
+    UnischemaField('rag', np.int16, (None,), ArrowListCodec(), False),
+    UnischemaField('label', np.int64, (), ScalarCodec(), False),
+])
+
+
+def _make_rows(n):
+    rng = np.random.default_rng(7)
+    return [{'idx': np.int64(i),
+             'image': rng.integers(0, 255, size=(12, 16), dtype=np.uint8),
+             'vec': rng.integers(0, 100, size=9).astype(np.int32),
+             'mat': rng.standard_normal((3, 4)).astype(np.float32),
+             'rag': np.arange(i % 5 + 1, dtype=np.int16),
+             'label': np.int64(i % 10)} for i in range(n)]
+
+
+@pytest.fixture(scope='module')
+def columnar_dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('columnar_ds')
+    url = 'file://' + str(path)
+    rows = _make_rows(120)
+    with materialize_dataset(url, ColumnarSchema, row_group_size_mb=0.05) as w:
+        w.write_rows(rows)
+    return url, rows
+
+
+def _collect_columnar(reader):
+    got = {}
+    for batch in reader:
+        for j in range(len(batch.idx)):
+            got[int(batch.idx[j])] = {f: getattr(batch, f)[j]
+                                      for f in batch._fields}
+    return got
+
+
+class TestColumnarReader:
+    def test_matches_row_path_value_exact(self, columnar_dataset):
+        url, rows = columnar_dataset
+        with make_reader(url, num_epochs=1, shuffle_row_groups=False) as r:
+            row_path = {int(row.idx): row for row in r}
+        with make_columnar_reader(url, num_epochs=1,
+                                  shuffle_row_groups=False) as r:
+            assert r.batched_output
+            col_path = _collect_columnar(r)
+        assert set(row_path) == set(col_path) == set(range(120))
+        for i in range(120):
+            for f in ('image', 'vec', 'mat', 'rag'):
+                np.testing.assert_array_equal(getattr(row_path[i], f),
+                                              col_path[i][f])
+            assert int(row_path[i].label) == int(col_path[i]['label'])
+
+    def test_dtypes_and_shapes(self, columnar_dataset):
+        url, _ = columnar_dataset
+        with make_columnar_reader(url, num_epochs=1) as r:
+            batch = next(iter(r))
+        assert batch.image.dtype == np.uint8 and batch.image.shape[1:] == (12, 16)
+        assert batch.vec.dtype == np.int32 and batch.vec.shape[1:] == (9,)
+        assert batch.mat.dtype == np.float32 and batch.mat.shape[1:] == (3, 4)
+        assert batch.rag.dtype == object           # wildcard shape stays ragged
+        assert isinstance(batch.rag[0], np.ndarray)
+
+    @pytest.mark.parametrize('pool', ['dummy', 'thread', 'process'])
+    def test_pool_matrix(self, columnar_dataset, pool):
+        url, _ = columnar_dataset
+        with make_columnar_reader(url, num_epochs=1, reader_pool_type=pool,
+                                  workers_count=2) as r:
+            got = _collect_columnar(r)
+        assert set(got) == set(range(120))
+
+    def test_worker_predicate(self, columnar_dataset):
+        url, _ = columnar_dataset
+        pred = in_lambda(['label'], lambda v: v['label'] == 3)
+        with make_columnar_reader(url, num_epochs=1, predicate=pred) as r:
+            got = _collect_columnar(r)
+        assert len(got) == 12
+        assert all(int(v['label']) == 3 for v in got.values())
+        assert all(i % 10 == 3 for i in got)
+
+    def test_schema_view_fields(self, columnar_dataset):
+        url, _ = columnar_dataset
+        with make_columnar_reader(url, num_epochs=1,
+                                  schema_fields=['idx', 'vec']) as r:
+            batch = next(iter(r))
+        assert set(batch._fields) == {'idx', 'vec'}
+
+    def test_transform_spec_columnar_contract(self, columnar_dataset):
+        url, _ = columnar_dataset
+
+        def double_vec(columns):
+            columns['vec'] = columns['vec'] * 2
+            return columns
+
+        spec = TransformSpec(double_vec)
+        with make_columnar_reader(url, num_epochs=1, shuffle_row_groups=False,
+                                  transform_spec=spec) as r:
+            got = _collect_columnar(r)
+        with make_columnar_reader(url, num_epochs=1,
+                                  shuffle_row_groups=False) as r:
+            plain = _collect_columnar(r)
+        for i in range(120):
+            np.testing.assert_array_equal(got[i]['vec'], plain[i]['vec'] * 2)
+
+    def test_shuffle_row_drop_partitions(self, columnar_dataset):
+        url, _ = columnar_dataset
+        with make_columnar_reader(url, num_epochs=1,
+                                  shuffle_row_drop_partitions=2) as r:
+            got = _collect_columnar(r)
+        assert set(got) == set(range(120))   # all partitions together = all rows
+
+    def test_ngram_rejected(self, columnar_dataset):
+        url, _ = columnar_dataset
+        from petastorm_tpu.ngram import NGram
+        fields = {0: ['idx'], 1: ['idx']}
+        ngram = NGram(fields=fields, delta_threshold=1, timestamp_field='idx')
+        with pytest.raises(ValueError, match='NGram'):
+            make_columnar_reader(url, schema_fields=ngram)
+
+
+class TestColumnarNullsAndBytes:
+    def test_nullable_codec_field_and_bytes_scalar(self, tmp_path):
+        from petastorm_tpu.codecs import NdarrayCodec
+        schema = Unischema('NullSchema', [
+            UnischemaField('idx', np.int64, (), ScalarCodec(), False),
+            UnischemaField('arr', np.float32, (3,), NdarrayCodec(), True),
+            UnischemaField('blob', bytes, (), ScalarCodec(), False),
+        ])
+        url = 'file://' + str(tmp_path)
+        rows = [{'idx': np.int64(i),
+                 'arr': None if i % 3 == 0 else np.full(3, i, np.float32),
+                 'blob': b'x' * (i + 1)} for i in range(30)]
+        with materialize_dataset(url, schema, row_group_size_mb=0.05) as w:
+            w.write_rows(rows)
+        with make_columnar_reader(url, num_epochs=1,
+                                  shuffle_row_groups=False) as r:
+            got = _collect_columnar(r)
+        assert set(got) == set(range(30))
+        for i in range(30):
+            if i % 3 == 0:
+                assert got[i]['arr'] is None
+            else:
+                np.testing.assert_array_equal(got[i]['arr'],
+                                              np.full(3, i, np.float32))
+            assert got[i]['blob'] == b'x' * (i + 1)
+
+
+class TestArrowListCodec:
+    def test_rejects_non_numeric(self):
+        field = UnischemaField('s', str, (3,), ArrowListCodec(), False)
+        with pytest.raises(ValueError, match='numeric'):
+            ArrowListCodec().arrow_type(field)
+
+    def test_rejects_multidim_wildcard(self):
+        field = UnischemaField('x', np.int32, (None, 4), ArrowListCodec(), False)
+        with pytest.raises(ValueError, match='1-D'):
+            ArrowListCodec().arrow_type(field)
+
+    def test_scalar_roundtrip(self):
+        field = UnischemaField('m', np.float32, (2, 3), ArrowListCodec(), False)
+        value = np.arange(6, dtype=np.float32).reshape(2, 3)
+        codec = ArrowListCodec()
+        encoded = codec.encode(field, value)
+        decoded = codec.decode(field, list(encoded))
+        np.testing.assert_array_equal(decoded, value)
+        assert decoded.dtype == np.float32
+
+
+class TestEpochCacheOnDevice:
+    def test_replays_identical_epochs(self, columnar_dataset):
+        url, _ = columnar_dataset
+        from petastorm_tpu.jax_utils import JaxDataLoader, epoch_cache_on_device
+        with make_columnar_reader(url, num_epochs=1,
+                                  shuffle_row_groups=False) as r:
+            loader = JaxDataLoader(r, batch_size=40, drop_last=True)
+            gen = epoch_cache_on_device(loader)
+            epoch1 = [next(gen) for _ in range(3)]
+            epoch2 = [next(gen) for _ in range(3)]
+        for b1, b2 in zip(epoch1, epoch2):
+            np.testing.assert_array_equal(np.asarray(b1['idx']),
+                                          np.asarray(b2['idx']))
+            np.testing.assert_array_equal(np.asarray(b1['vec']),
+                                          np.asarray(b2['vec']))
+
+    def test_empty_loader_terminates(self):
+        from petastorm_tpu.jax_utils import epoch_cache_on_device
+        assert list(epoch_cache_on_device([])) == []
