@@ -72,6 +72,41 @@ def attention_block_step(q, k, v, o, m, l, *, scale=None,
     return _block_update(q, k, v, o, m, l, scale, mask)
 
 
+def _pad_kv(k32, v32, block_k: int):
+    """Pad k/v along the sequence dim to a block multiple; returns
+    (k, v, num_blocks)."""
+    k_len = k32.shape[-2]
+    pad = (-k_len) % block_k
+    if pad:
+        pad_width = [(0, 0)] * (k32.ndim - 2) + [(0, pad), (0, 0)]
+        k32 = jnp.pad(k32, pad_width)
+        v32 = jnp.pad(v32, pad_width)
+    return k32, v32, (k_len + pad) // block_k
+
+
+def _to_kv_blocks(x, num_blocks: int, block_k: int):
+    """(..., nb*bk, D) -> (nb, ..., bk, D) for scanning."""
+    x = jnp.moveaxis(x, -2, 0)
+    x = x.reshape((num_blocks, block_k) + x.shape[1:])
+    return jnp.moveaxis(x, 1, -2)
+
+
+def _from_kv_blocks(xb, num_blocks: int, block_k: int):
+    """Inverse of :func:`_to_kv_blocks`."""
+    xb = jnp.moveaxis(xb, -2, 1)
+    xb = xb.reshape((num_blocks * block_k,) + xb.shape[2:])
+    return jnp.moveaxis(xb, 0, -2)
+
+
+def _kv_block_mask(q_pos, blk_idx, block_k: int, kv_len: int, causal: bool):
+    """(Lq, bk) validity mask for one kv block: tail padding + causality."""
+    k_pos = blk_idx * block_k + jnp.arange(block_k)
+    mask = jnp.broadcast_to(k_pos[None, :] < kv_len, (q_pos.shape[0], block_k))
+    if causal:
+        mask = mask & (q_pos[:, None] >= k_pos[None, :])
+    return mask
+
+
 def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512):
     """Memory-efficient attention: scan over key/value blocks with online
     softmax. Works on any backend; O(L·block_k) live memory per head.
@@ -84,33 +119,16 @@ def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512):
     q_len, k_len = q.shape[-2], k.shape[-2]
     batch_shape = q.shape[:-2]
 
-    pad = (-k_len) % block_k
-    if pad:
-        pad_width = [(0, 0)] * (k32.ndim - 2) + [(0, pad), (0, 0)]
-        k32 = jnp.pad(k32, pad_width)
-        v32 = jnp.pad(v32, pad_width)
-    padded_k_len = k_len + pad
-    num_blocks = padded_k_len // block_k
-
-    # (num_blocks, ..., block_k, D) for scanning
-    def to_blocks(x):
-        x = jnp.moveaxis(x, -2, 0)                     # (Lk, ..., D)
-        x = x.reshape((num_blocks, block_k) + x.shape[1:])
-        return jnp.moveaxis(x, 1, -2)                  # (nb, ..., block_k, D)
-
-    kb, vb = to_blocks(k32), to_blocks(v32)
+    k32, v32, num_blocks = _pad_kv(k32, v32, block_k)
+    kb = _to_kv_blocks(k32, num_blocks, block_k)
+    vb = _to_kv_blocks(v32, num_blocks, block_k)
     q_pos = jnp.arange(q_len)
     o, m, l = attention_accumulators(q_len, q.shape[-1], batch_shape)
 
     def step(carry, inputs):
         o, m, l = carry
         k_blk, v_blk, blk_idx = inputs
-        k_pos = blk_idx * block_k + jnp.arange(block_k)
-        valid = k_pos < k_len                           # mask tail padding
-        if causal:
-            mask = (q_pos[:, None] >= k_pos[None, :]) & valid[None, :]
-        else:
-            mask = jnp.broadcast_to(valid[None, :], (q_len, block_k))
+        mask = _kv_block_mask(q_pos, blk_idx, block_k, k_len, causal)
         o, m, l = _block_update(q32, k_blk, v_blk, o, m, l, scale, mask)
         return (o, m, l), None
 
@@ -123,105 +141,220 @@ def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512):
 # Pallas TPU kernel
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, q_seq_len: int, kv_seq_len: int, block_q: int):
-    """One (batch·head, q-block) program: scan kv blocks held in VMEM.
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *refs, block_q: int,
+                  block_k: int, causal: bool, scale: float, kv_seq_len: int,
+                  num_kv_blocks: int, with_lse: bool):
+    """One (batch·head, q-block, kv-block) grid step.
 
-    Block shapes: q_ref (block_q, D), k_ref/v_ref (kv_seq_len, D) — the kernel
-    slices kv blocks itself so the MXU sees (block_q, D) x (D, block_k) matmuls.
+    KV **streams through the grid**: each program sees only a (block_k, D)
+    slice of k/v in VMEM — bounded VMEM at any sequence length (the previous
+    revision pinned the full kv sequence per program, ~2·L·D·4B, which blew
+    VMEM exactly in the long-context regime the kernel exists for). The
+    online-softmax accumulators (o, m, l) persist across the sequential
+    kv-block grid dimension in VMEM scratch; the final kv step normalizes and
+    writes the output block plus its logsumexp (saved for the backward).
     """
     from jax.experimental import pallas as pl
 
-    q_blk_idx = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32)
-    q_pos = q_blk_idx * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, 1), 0).squeeze(-1)
+    if with_lse:
+        lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        lse_ref, (acc_ref, m_ref, l_ref) = None, refs
+    q_idx = pl.program_id(1)
+    kv_idx = pl.program_id(2)
 
-    num_kv_blocks = kv_seq_len // block_k
-
-    def body(kv_idx, carry):
-        o, m, l = carry
-        k = k_ref[pl.ds(kv_idx * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kv_idx * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_k), 1).squeeze(0)
-        if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        if causal:
-            p = jnp.where(mask, p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return o_new, m_new, l_new
-
-    o0 = jnp.zeros_like(q)
-    m0 = jnp.full((block_q,), _NEG_INF, dtype=jnp.float32)
-    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
+    @pl.when(kv_idx == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
     if causal:
         # Skip kv blocks strictly above the causal diagonal for this q block.
-        upper = jax.lax.div(
-            (q_blk_idx + 1) * block_q + block_k - 1, block_k)
-        upper = jnp.minimum(upper, num_kv_blocks)
+        needed = kv_idx * block_k <= (q_idx + 1) * block_q - 1
     else:
-        upper = num_kv_blocks
-    o, m, l = jax.lax.fori_loop(0, upper, body, (o0, m0, l0))
-    safe_l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[...] = (o / safe_l[:, None]).astype(o_ref.dtype)
+        needed = kv_idx >= 0
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[...].astype(jnp.float32)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        mask = k_pos < kv_seq_len                      # tail-padding mask
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            mask = mask & (q_pos >= k_pos)
+        mask = jnp.broadcast_to(mask, s.shape)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[...][:, :1]                     # (bq, 1)
+        l_prev = l_ref[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)                 # (bq, 1)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kv_idx == num_kv_blocks - 1)
+    def _final():
+        l = l_ref[...][:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+        if with_lse:
+            lse = jnp.where(l == 0.0, jnp.float32(_NEG_INF),
+                            m_ref[...][:, :1] + jnp.log(safe_l))
+            # (bq, 128) lane-replicated: TPU blocks want last-two dims
+            # (8, 128)-divisible, so a 1-D (bq,) output block is not lowerable.
+            lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
 def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
-                  interpret: bool = False):
+                  interpret: bool = False, with_lse: bool = True):
+    """Returns ``(o, lse)`` with o in q's dtype and lse float32 ``(..., Lq)``
+    — lse is None when ``with_lse=False`` (the no-grad forward skips the
+    lane-replicated lse write entirely). Non-block-divisible lengths are
+    padded and the pad is masked/sliced."""
     from jax.experimental import pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
 
     *batch, q_len, head_dim = q.shape
     kv_len = k.shape[-2]
     bq = min(block_q, q_len)
     bk = min(block_k, kv_len)
-    if q_len % bq or kv_len % bk:
-        raise ValueError('sequence lengths must be divisible by block sizes '
-                         '(q: {} % {}, kv: {} % {})'.format(q_len, bq, kv_len, bk))
-    flat = int(jnp.prod(jnp.asarray(batch))) if batch else 1
-    qf = q.reshape(flat, q_len, head_dim)
-    kf = k.reshape(flat, kv_len, head_dim)
-    vf = v.reshape(flat, kv_len, head_dim)
-    scale = 1.0 / math.sqrt(head_dim)
+    pad_q = (-q_len) % bq
+    pad_k = (-kv_len) % bk
+    if pad_q:
+        pad_width = [(0, 0)] * (q.ndim - 2) + [(0, pad_q), (0, 0)]
+        q = jnp.pad(q, pad_width)
+    if pad_k:
+        pad_width = [(0, 0)] * (k.ndim - 2) + [(0, pad_k), (0, 0)]
+        k = jnp.pad(k, pad_width)
+        v = jnp.pad(v, pad_width)
+    pq_len, pk_len = q_len + pad_q, kv_len + pad_k
 
-    kernel = functools.partial(_flash_kernel, block_k=bk, causal=causal,
-                               scale=scale, q_seq_len=q_len, kv_seq_len=kv_len,
-                               block_q=bq)
-    out = pl.pallas_call(
+    flat = int(math.prod(batch)) if batch else 1
+    qf = q.reshape(flat, pq_len, head_dim)
+    kf = k.reshape(flat, pk_len, head_dim)
+    vf = v.reshape(flat, pk_len, head_dim)
+    scale = 1.0 / math.sqrt(head_dim)
+    num_kv_blocks = pk_len // bk
+
+    kernel = functools.partial(
+        _flash_kernel, block_q=bq, block_k=bk, causal=causal, scale=scale,
+        kv_seq_len=kv_len, num_kv_blocks=num_kv_blocks, with_lse=with_lse)
+    out_specs = [pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((flat, pq_len, head_dim), q.dtype)]
+    if with_lse:
+        out_specs.append(pl.BlockSpec((None, bq, 128), lambda b, i, j: (b, i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((flat, pq_len, 128), jnp.float32))
+    result = pl.pallas_call(
         kernel,
-        grid=(flat, q_len // bq),
+        grid=(flat, pq_len // bq, num_kv_blocks),
         in_specs=[
-            pl.BlockSpec((None, bq, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, kv_len, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, kv_len, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, bq, head_dim), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((flat, q_len, head_dim), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((bq, head_dim), jnp.float32),   # o accumulator
+            pltpu.VMEM((bq, 128), jnp.float32),        # running max (lanes equal)
+            pltpu.VMEM((bq, 128), jnp.float32),        # running sum (lanes equal)
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(q.shape)
+    o = result[0][:, :q_len, :].reshape(tuple(batch) + (q_len, head_dim))
+    if not with_lse:
+        return o, None
+    lse = result[1][:, :q_len, 0].reshape(tuple(batch) + (q_len,))
+    return o, lse
+
+
+def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_k: int,
+                    scale: Optional[float] = None):
+    """Memory-efficient flash backward (any backend): scan over kv blocks,
+    recomputing p from (q, k, lse); O(Lq·block_k) live memory.
+
+    dq accumulates across blocks; dk/dv are block-local scan outputs.
+    """
+    orig_dtypes = (q.dtype, k.dtype, v.dtype)
+    q32, k32, v32, o32, do32 = (x.astype(jnp.float32)
+                                for x in (q, k, v, o, do))
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    q_len, kv_len = q.shape[-2], k.shape[-2]
+    bk = min(block_k, kv_len)
+    k32, v32, num_blocks = _pad_kv(k32, v32, bk)
+    kb = _to_kv_blocks(k32, num_blocks, bk)
+    vb = _to_kv_blocks(v32, num_blocks, bk)
+    q_pos = jnp.arange(q_len)
+    # D_i = rowsum(do_i * o_i) — the only residual beyond lse
+    d_term = jnp.sum(do32 * o32, axis=-1)            # (..., Lq)
+
+    def step(dq, inputs):
+        k_blk, v_blk, blk_idx = inputs
+        mask = _kv_block_mask(q_pos, blk_idx, bk, kv_len, causal)
+        s = jnp.einsum('...qd,...kd->...qk', q32, k_blk) * scale
+        p = jnp.exp(s - lse[..., None])
+        p = jnp.where(jnp.broadcast_to(mask, p.shape), p, 0.0)
+        dv_blk = jnp.einsum('...qk,...qd->...kd', p, do32)
+        dp = jnp.einsum('...qd,...kd->...qk', do32, v_blk)
+        ds = p * (dp - d_term[..., None]) * scale
+        dq = dq + jnp.einsum('...qk,...kd->...qd', ds, k_blk)
+        dk_blk = jnp.einsum('...qk,...qd->...kd', ds, q32)
+        return dq, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros(q32.shape, jnp.float32)
+    dq, (dkb, dvb) = jax.lax.scan(step, dq0,
+                                  (kb, vb, jnp.arange(num_blocks)))
+    dk = _from_kv_blocks(dkb, num_blocks, bk)[..., :kv_len, :]
+    dv = _from_kv_blocks(dvb, num_blocks, bk)[..., :kv_len, :]
+    return (dq.astype(orig_dtypes[0]), dk.astype(orig_dtypes[1]),
+            dv.astype(orig_dtypes[2]))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    o, _ = _pallas_flash(q, k, v, causal, block_q, block_k, interpret,
+                         with_lse=False)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _pallas_flash(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _flash_backward(q, k, v, o, lse, do, causal=causal, block_k=block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
                     block_k: int = 512, backend: Optional[str] = None):
-    """Fused attention over ``(..., L, D)`` inputs.
+    """Fused attention over ``(..., L, D)`` inputs; differentiable (custom_vjp
+    with a flash-style blockwise backward), any sequence length (padded to
+    block multiples internally).
 
     ``backend``: 'pallas' forces the TPU kernel, 'jnp' the scan fallback,
     'interpret' the Pallas interpreter (CI on CPU); default picks Pallas on TPU.
     """
     if backend is None:
         backend = 'pallas' if jax.default_backend() == 'tpu' else 'jnp'
-    if backend == 'pallas':
-        return _pallas_flash(q, k, v, causal, block_q, block_k)
-    if backend == 'interpret':
-        return _pallas_flash(q, k, v, causal, block_q, block_k, interpret=True)
+    if backend in ('pallas', 'interpret'):
+        return _flash(q, k, v, causal, block_q, block_k, backend == 'interpret')
     return blockwise_attention(q, k, v, causal=causal, block_k=block_k)

@@ -16,7 +16,33 @@ _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
 
+# Environments that register accelerator plugins at interpreter startup (via
+# sitecustomize) may override JAX_PLATFORMS with jax.config.update, silently
+# moving "CPU" tests onto real hardware with bf16 matmul defaults. Re-assert
+# the CPU platform at config level — but only when the env really asks for
+# CPU, so an explicit JAX_PLATFORMS=tpu (TPU CI) still reaches hardware.
+if os.environ.get('JAX_PLATFORMS') == 'cpu':
+    try:
+        import jax as _jax
+        _jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+
 import pytest  # noqa: E402
+
+
+def ref_attention(q, k, v, causal=True):
+    """Dense-softmax attention reference shared by the kernel test modules."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    d = q.shape[-1]
+    s = jnp.einsum('...qd,...kd->...qk', q, k) / np.sqrt(d)
+    if causal:
+        l_q, l_k = q.shape[-2], k.shape[-2]
+        mask = np.tril(np.ones((l_q, l_k), bool))
+        s = jnp.where(mask, s, -1e30)
+    return jnp.einsum('...qk,...kd->...qd', jax.nn.softmax(s, axis=-1), v)
 
 
 @pytest.fixture(scope='session')

@@ -22,14 +22,7 @@ def cpus():
     return devices
 
 
-def _ref_attention(q, k, v, causal=True):
-    d = q.shape[-1]
-    s = jnp.einsum('...qd,...kd->...qk', q, k) / np.sqrt(d)
-    if causal:
-        l_q, l_k = q.shape[-2], k.shape[-2]
-        mask = np.tril(np.ones((l_q, l_k), bool))
-        s = jnp.where(mask, s, -1e30)
-    return jnp.einsum('...qk,...kd->...qd', jax.nn.softmax(s, axis=-1), v)
+from conftest import ref_attention as _ref_attention  # noqa: E402
 
 
 @pytest.fixture(scope='module')
@@ -64,25 +57,8 @@ class TestBlockwiseAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
-class TestPallasFlashInterpret:
-    @pytest.mark.parametrize('causal', [True, False])
-    def test_matches_reference(self, qkv, cpus, causal):
-        from petastorm_tpu.ops.attention import flash_attention
-        q, k, v = qkv
-        with jax.default_device(cpus[0]):
-            out = flash_attention(q, k, v, causal=causal, block_q=32,
-                                  block_k=32, backend='interpret')
-            ref = _ref_attention(q, k, v, causal=causal)
-        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                   atol=1e-5, rtol=1e-5)
-
-    def test_rejects_indivisible_blocks(self, cpus):
-        from petastorm_tpu.ops.attention import flash_attention
-        with jax.default_device(cpus[0]):
-            q = jnp.zeros((1, 1, 100, 16))
-            with pytest.raises(ValueError, match='divisible'):
-                flash_attention(q, q, q, block_q=64, block_k=64,
-                                backend='interpret')
+# Pallas flash-kernel tests (interpret + TPU-gated) live in
+# tests/test_flash_attention.py.
 
 
 class TestRingAttention:
