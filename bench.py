@@ -25,6 +25,7 @@ BASELINE_SAMPLES_PER_SEC = 709.84   # reference docs/benchmarks_tutorial.rst:20-
 DATASET_PATH = '/tmp/petastorm_tpu_hello_world_bench'
 MNIST_PATH = '/tmp/petastorm_tpu_northstar_mnist'
 TOKENS_PATH = '/tmp/petastorm_tpu_northstar_tokens'
+IMAGENET_PATH = '/tmp/petastorm_tpu_northstar_imagenet'
 
 
 def _probe_platform():
@@ -90,6 +91,13 @@ def main():
             lambda: northstar.generate_token_dataset(
                 tokens_url, rows=tokens_rows, seq_len=seq_len))
 
+    imagenet_rows = 256 if on_tpu else 48
+    imagenet_path = '{}_{}'.format(IMAGENET_PATH, imagenet_rows)
+    imagenet_url = 'file://' + imagenet_path
+    _ensure(imagenet_path, '_common_metadata',
+            lambda: northstar.generate_imagenet_dataset(
+                imagenet_url, rows=imagenet_rows))
+
     if on_tpu:
         mnist = northstar.run_mnist_train_bench(
             mnist_url, batch_size=mnist_batch, num_steps=60, hidden=2048)
@@ -98,6 +106,9 @@ def main():
             hidden=2048)
         lm = northstar.run_transformer_train_bench(
             tokens_url, batch_size=64, num_steps=40, seq_len=seq_len)
+        img_decode = northstar.run_image_decode_bench(imagenet_url)
+        imagenet = northstar.run_imagenet_train_bench(
+            imagenet_url, batch_size=32, num_steps=20)
     else:
         mnist = northstar.run_mnist_train_bench(
             mnist_url, batch_size=mnist_batch, num_steps=15, hidden=256)
@@ -107,6 +118,10 @@ def main():
         lm = northstar.run_transformer_train_bench(
             tokens_url, batch_size=8, num_steps=8, seq_len=seq_len,
             d_model=128, n_layers=2, d_ff=512)
+        img_decode = northstar.run_image_decode_bench(imagenet_url,
+                                                     image_size=96)
+        imagenet = northstar.run_imagenet_train_bench(
+            imagenet_url, batch_size=8, num_steps=4, image_size=96)
 
     print(json.dumps({
         'metric': 'hello_world_reader_throughput',
@@ -118,6 +133,8 @@ def main():
             'mnist_train': mnist.as_dict(),
             'mnist_train_cached': mnist_cached.as_dict(),
             'transformer_train': lm.as_dict(),
+            'image_decode': img_decode,
+            'imagenet_train': imagenet.as_dict(),
         },
     }))
 

@@ -1,0 +1,15 @@
+"""ImageNet dataset schema (reference parity:
+``/root/reference/examples/imagenet/schema.py:21-25`` — noun_id, text, and a
+variable-shaped png-compressed RGB image)."""
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+ImagenetSchema = Unischema('ImagenetSchema', [
+    UnischemaField('noun_id', str, (), ScalarCodec(), False),
+    UnischemaField('text', str, (), ScalarCodec(), False),
+    UnischemaField('label', np.int64, (), ScalarCodec(), False),
+    UnischemaField('image', np.uint8, (None, None, 3), CompressedImageCodec('png'), False),
+])

@@ -38,3 +38,24 @@ def cast_string_to_type(target_type, value: str):
     if target_type is bool:
         return parse_bool_string(value)
     return target_type(value)
+
+
+def reassert_cpu_platform():
+    """Re-assert ``jax_platforms='cpu'`` at config level when the environment
+    asks for CPU.
+
+    Environments that register accelerator plugins from ``sitecustomize`` may
+    call ``jax.config.update('jax_platforms', ...)`` at interpreter startup,
+    which takes precedence over the ``JAX_PLATFORMS`` env var — silently
+    moving "CPU" runs onto real hardware (bf16 matmul defaults, shared chip).
+    Call this after setting ``JAX_PLATFORMS=cpu``; no-op otherwise so an
+    explicit accelerator selection still reaches hardware.
+    """
+    import os
+    if os.environ.get('JAX_PLATFORMS') != 'cpu':
+        return
+    try:
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass

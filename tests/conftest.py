@@ -16,17 +16,11 @@ _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
 
-# Environments that register accelerator plugins at interpreter startup (via
-# sitecustomize) may override JAX_PLATFORMS with jax.config.update, silently
-# moving "CPU" tests onto real hardware with bf16 matmul defaults. Re-assert
-# the CPU platform at config level — but only when the env really asks for
-# CPU, so an explicit JAX_PLATFORMS=tpu (TPU CI) still reaches hardware.
-if os.environ.get('JAX_PLATFORMS') == 'cpu':
-    try:
-        import jax as _jax
-        _jax.config.update('jax_platforms', 'cpu')
-    except Exception:
-        pass
+# Plugin sitecustomize may override JAX_PLATFORMS at config level; re-assert
+# CPU when the env asks for it (no-op for explicit JAX_PLATFORMS=tpu CI).
+from petastorm_tpu.utils import reassert_cpu_platform  # noqa: E402
+
+reassert_cpu_platform()
 
 import pytest  # noqa: E402
 

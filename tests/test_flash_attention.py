@@ -18,6 +18,14 @@ from petastorm_tpu.ops.attention import blockwise_attention, flash_attention
 _RNG = np.random.default_rng(0)
 
 
+@pytest.fixture()
+def cpu():
+    """Pin exactness tests to a CPU device — the session may have an
+    accelerator attached (bf16 MXU matmuls would blur the comparisons)."""
+    with jax.default_device(jax.devices('cpu')[0]):
+        yield
+
+
 def _mk(b, h, lq, lk, d, dtype=jnp.float32):
     q = jnp.asarray(_RNG.standard_normal((b, h, lq, d)), dtype)
     k = jnp.asarray(_RNG.standard_normal((b, h, lk, d)), dtype)
@@ -32,7 +40,7 @@ class TestFlashInterpret:
         (128, 384, False),          # cross lengths
         (300, 130, True),           # ragged both ways
     ])
-    def test_forward_matches_exact(self, lq, lk, causal):
+    def test_forward_matches_exact(self, cpu, lq, lk, causal):
         q, k, v = _mk(2, 3, lq, lk, 64)
         out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128,
                               backend='interpret')
@@ -42,7 +50,7 @@ class TestFlashInterpret:
 
     @pytest.mark.parametrize('lq,lk,causal', [(192, 192, True),
                                               (100, 70, False)])
-    def test_grad_matches_blockwise_autodiff(self, lq, lk, causal):
+    def test_grad_matches_blockwise_autodiff(self, cpu, lq, lk, causal):
         q, k, v = _mk(2, 2, lq, lk, 32)
 
         def loss_flash(q, k, v):
@@ -60,7 +68,7 @@ class TestFlashInterpret:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-3, rtol=1e-3)
 
-    def test_bf16_forward(self):
+    def test_bf16_forward(self, cpu):
         q, k, v = _mk(1, 2, 128, 128, 64, jnp.bfloat16)
         out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
                               backend='interpret')
@@ -69,7 +77,7 @@ class TestFlashInterpret:
         np.testing.assert_allclose(np.asarray(out, np.float32),
                                    np.asarray(ref), atol=3e-2)
 
-    def test_jnp_backend_is_blockwise(self):
+    def test_jnp_backend_is_blockwise(self, cpu):
         q, k, v = _mk(1, 1, 64, 64, 16)
         a = flash_attention(q, k, v, causal=True, backend='jnp')
         b = blockwise_attention(q, k, v, causal=True)
