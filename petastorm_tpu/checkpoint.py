@@ -15,6 +15,12 @@ the reader (shuffle order) and loader (buffer RNG), and use a deterministic
 results order (``reader_pool_type='dummy'`` or ``workers_count=1``). With a
 nondeterministic pool the resume is best-effort: epoch boundaries are exact,
 the intra-epoch position is approximate.
+
+For **O(1) exact resume with any worker count** use
+:mod:`petastorm_tpu.indexed` (``make_indexed_loader``): batches are addressed
+by (seed, epoch, index), so its cursor restores instantly and byte-exactly —
+no replay. This module remains the replay fallback for the queue-based
+streaming readers (NGram, predicates, ragged fields).
 """
 
 from __future__ import annotations

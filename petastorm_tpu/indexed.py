@@ -1,0 +1,318 @@
+"""Deterministic random-access loader with O(1) exact resume.
+
+The reference cannot resume mid-epoch at all (``reader.py:468-492``; SURVEY
+§5.4), and any queue-based pool makes the stream order scheduling-dependent.
+This module takes the TPU-native route (the design Grain uses for the same
+problem): **batch b of epoch e is a pure function of (dataset, seed, e, b)**.
+
+- :class:`IndexedDatasetReader` gives random-access decoded reads over the
+  row groups of a petastorm_tpu dataset (LRU row-group cache, columnar
+  decode — no per-row Python).
+- :class:`IndexedBatchLoader` derives a per-epoch window-shuffled permutation
+  of global row indices from ``(seed, epoch)``, slices it into fixed batches,
+  prefetches upcoming batches on a thread pool **by index**, and reorders
+  results — so pool scheduling cannot perturb the stream. Killing the loader
+  and restoring ``state_dict()`` elsewhere reproduces the remaining stream
+  byte-for-byte, in O(1) (no replay).
+
+Window shuffling bounds decode amplification: rows are shuffled within
+windows of ``shuffle_window_groups`` consecutive row groups (window order
+also shuffled), so a batch touches at most a few row groups while the
+window size controls shuffle quality — the knob ``shuffle_row_drop_partitions``
+approximates in the queue-based reader (reference ``reader.py:61-96``).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from petastorm_tpu.errors import NoDataAvailableError
+from petastorm_tpu.etl.dataset_metadata import get_schema, load_row_groups
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dataset_url_or_urls
+from petastorm_tpu.readers.columnar_worker import _column_to_numpy
+from petastorm_tpu.unischema import match_unischema_fields
+from petastorm_tpu.workers import EmptyResultError
+from petastorm_tpu.workers.thread_pool import ThreadPool
+from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+from petastorm_tpu.workers.worker_base import WorkerBase
+
+
+class IndexedDatasetReader:
+    """Random-access decoded reads over a petastorm_tpu dataset.
+
+    ``read_piece(i)`` returns the decoded columns of row group ``i`` (through
+    a bounded LRU cache); global row index arithmetic is exposed via
+    ``row_offsets`` / ``total_rows``. Thread-safe.
+    """
+
+    def __init__(self, dataset_url: str, schema_fields: Optional[List[str]] = None,
+                 storage_options=None, cache_groups: int = 8):
+        dataset_url = normalize_dataset_url_or_urls(dataset_url)
+        fs, path, _ = get_filesystem_and_path_or_paths(dataset_url, storage_options)
+        if isinstance(path, list):
+            raise ValueError('IndexedDatasetReader needs a single dataset url')
+        self._filesystem = fs
+        self._path = path
+        stored_schema = get_schema(fs, path)
+        if schema_fields is not None:
+            matched = match_unischema_fields(stored_schema, schema_fields)
+            if not matched:
+                raise ValueError('schema_fields {} matched no fields'.format(
+                    schema_fields))
+            self.schema = stored_schema.create_schema_view(matched)
+        else:
+            self.schema = stored_schema
+        self.pieces = load_row_groups(fs, path)
+        if not self.pieces:
+            raise NoDataAvailableError('No row groups at {}'.format(path))
+        if any(p.num_rows < 0 for p in self.pieces):
+            raise ValueError('IndexedDatasetReader needs per-row-group row '
+                             'counts (regenerate dataset metadata)')
+        counts = np.asarray([p.num_rows for p in self.pieces], np.int64)
+        #: row_offsets[i] = global index of the first row of piece i
+        self.row_offsets = np.concatenate([[0], np.cumsum(counts)])
+        self.total_rows = int(self.row_offsets[-1])
+
+        self._cache: 'collections.OrderedDict[int, Dict[str, np.ndarray]]' = \
+            collections.OrderedDict()
+        self._cache_groups = cache_groups
+        self._lock = threading.Lock()
+        self._files = {}
+
+    # -- io --------------------------------------------------------------------
+
+    def _parquet_file(self, path: str):
+        import pyarrow.parquet as pq
+        with self._lock:
+            pf = self._files.get(path)
+        if pf is not None:
+            return pf
+        pf = pq.ParquetFile(self._filesystem.open(path, 'rb'))
+        with self._lock:
+            return self._files.setdefault(path, pf)
+
+    def read_piece(self, piece_index: int) -> Dict[str, np.ndarray]:
+        with self._lock:
+            cached = self._cache.get(piece_index)
+            if cached is not None:
+                self._cache.move_to_end(piece_index)
+                return cached
+        piece = self.pieces[piece_index]
+        names = list(self.schema.fields.keys())
+        partition_keys = set(piece.partition_dict.keys())
+        stored = [n for n in names if n not in partition_keys]
+        table = self._parquet_file(piece.path).read_row_group(
+            piece.row_group, columns=stored)
+        columns = {}
+        for name in names:
+            if name in table.column_names:
+                columns[name] = _column_to_numpy(table.column(name),
+                                                 self.schema.fields[name])
+        from petastorm_tpu.utils import cast_partition_value
+        for key, value in piece.partition_dict.items():
+            if key in self.schema.fields:
+                field = self.schema.fields[key]
+                typed = cast_partition_value(field.numpy_dtype, value)
+                if isinstance(typed, str):
+                    col = np.empty(table.num_rows, dtype=object)
+                    col[:] = typed
+                else:
+                    col = np.full(table.num_rows, typed)
+                columns[key] = col
+        with self._lock:
+            self._cache[piece_index] = columns
+            while len(self._cache) > self._cache_groups:
+                self._cache.popitem(last=False)
+        return columns
+
+    def gather(self, global_rows: np.ndarray) -> Dict[str, np.ndarray]:
+        """Decoded columns for the given global row indices, in order."""
+        piece_ids = np.searchsorted(self.row_offsets, global_rows,
+                                    side='right') - 1
+        local = global_rows - self.row_offsets[piece_ids]
+        out: Dict[str, np.ndarray] = {}
+        for p in np.unique(piece_ids):
+            mask = piece_ids == p
+            cols = self.read_piece(int(p))
+            idx = local[mask]
+            for name, col in cols.items():
+                if name not in out:
+                    out[name] = np.empty((len(global_rows),) + col.shape[1:],
+                                         dtype=col.dtype)
+                out[name][mask] = col[idx]
+        return out
+
+
+def epoch_permutation(total_rows: int, row_offsets: np.ndarray, seed, epoch: int,
+                      shuffle: bool = True,
+                      shuffle_window_groups: int = 4) -> np.ndarray:
+    """The (seed, epoch)-deterministic global row order: shuffle row-group
+    window order, then rows within each window."""
+    if not shuffle:
+        return np.arange(total_rows, dtype=np.int64)
+    rng = np.random.default_rng((seed, epoch))
+    n_pieces = len(row_offsets) - 1
+    group_order = rng.permutation(n_pieces)
+    out = []
+    for start in range(0, n_pieces, shuffle_window_groups):
+        window = group_order[start:start + shuffle_window_groups]
+        idx = np.concatenate([np.arange(row_offsets[g], row_offsets[g + 1],
+                                        dtype=np.int64) for g in window])
+        rng.shuffle(idx)
+        out.append(idx)
+    return np.concatenate(out) if out else np.empty(0, np.int64)
+
+
+class _IndexedBatchWorker(WorkerBase):
+    """Assembles ventilated (epoch, batch) items into column batches."""
+
+    def __init__(self, worker_id, publish_func, args):
+        super().__init__(worker_id, publish_func, args)
+        self._loader = args['loader']
+
+    def process(self, epoch: int, batch: int):
+        columns = self._loader._assemble(epoch, batch)
+        self.publish_func((epoch, batch, columns))
+
+
+class IndexedBatchLoader:
+    """Deterministic batch stream with O(1) exact checkpoint/resume.
+
+    Yields dicts of numpy column arrays of exactly ``batch_size`` rows
+    (``drop_last`` is forced: deterministic indexing needs a fixed batch
+    grid; the tail rows of an epoch rotate in via the next epoch's shuffle).
+
+    :param seed: with ``shuffle=True``, the stream is a pure function of
+        (dataset, seed); two loaders with equal parameters yield identical
+        streams regardless of worker scheduling.
+    :param workers_count: thread-pool width prefetching batches by index.
+    :param prefetch_batches: bound on assembled-but-unconsumed batches.
+
+    Checkpointing::
+
+        state = loader.state_dict()          # {'epoch': e, 'batch': b}
+        ...
+        restored = IndexedBatchLoader(same_args...)
+        restored.load_state_dict(state)
+        for batch in restored:               # continues exactly at (e, b)
+            ...
+    """
+
+    def __init__(self, dataset: IndexedDatasetReader, batch_size: int,
+                 num_epochs: int = 1, seed: int = 0, shuffle: bool = True,
+                 shuffle_window_groups: int = 4, workers_count: int = 4,
+                 prefetch_batches: int = 8):
+        if num_epochs is None:
+            raise ValueError('IndexedBatchLoader needs a finite num_epochs '
+                             '(the resume cursor indexes a finite schedule)')
+        self._dataset = dataset
+        self.batch_size = batch_size
+        self.num_epochs = num_epochs
+        self.seed = seed
+        self.shuffle = shuffle
+        self.shuffle_window_groups = shuffle_window_groups
+        self.workers_count = workers_count
+        self.prefetch_batches = prefetch_batches
+        self.batches_per_epoch = dataset.total_rows // batch_size
+        if self.batches_per_epoch == 0:
+            raise NoDataAvailableError(
+                'Dataset has {} rows < batch_size {}'.format(
+                    dataset.total_rows, batch_size))
+        self.epoch = 0
+        self.batch = 0
+        self._perm_cache: 'collections.OrderedDict[int, np.ndarray]' = \
+            collections.OrderedDict()
+        self._perm_lock = threading.Lock()
+
+    # -- deterministic addressing ---------------------------------------------
+
+    def _permutation(self, epoch: int) -> np.ndarray:
+        with self._perm_lock:
+            perm = self._perm_cache.get(epoch)
+            if perm is not None:
+                return perm
+        perm = epoch_permutation(self._dataset.total_rows,
+                                 self._dataset.row_offsets, self.seed, epoch,
+                                 self.shuffle, self.shuffle_window_groups)
+        with self._perm_lock:
+            self._perm_cache[epoch] = perm
+            while len(self._perm_cache) > 2:
+                self._perm_cache.popitem(last=False)
+        return perm
+
+    def _assemble(self, epoch: int, batch: int) -> Dict[str, np.ndarray]:
+        rows = self._permutation(epoch)[batch * self.batch_size:
+                                        (batch + 1) * self.batch_size]
+        return self._dataset.gather(rows)
+
+    # -- checkpoint state ------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, int]:
+        """Cursor of the NEXT batch to yield; O(1) to save and restore."""
+        return {'epoch': self.epoch, 'batch': self.batch, 'version': 1}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        if state.get('version', 1) != 1:
+            raise ValueError('Unknown state version {}'.format(state.get('version')))
+        self.epoch = int(state['epoch'])
+        self.batch = int(state['batch'])
+        if self.batch >= self.batches_per_epoch:
+            self.epoch += self.batch // self.batches_per_epoch
+            self.batch = self.batch % self.batches_per_epoch
+
+    # -- iteration -------------------------------------------------------------
+
+    def __iter__(self):
+        schedule = [(e, b)
+                    for e in range(self.epoch, self.num_epochs)
+                    for b in range(self.batch if e == self.epoch else 0,
+                                   self.batches_per_epoch)]
+        if not schedule:
+            return
+        pool = ThreadPool(self.workers_count,
+                          results_queue_size=self.prefetch_batches)
+        ventilator = ConcurrentVentilator(
+            pool.ventilate,
+            [{'epoch': e, 'batch': b} for e, b in schedule],
+            iterations=1, randomize_item_order=False,
+            max_ventilation_queue_size=self.workers_count
+            + self.prefetch_batches)
+        pool.start(_IndexedBatchWorker, {'loader': self}, ventilator)
+        stash: Dict[tuple, Dict[str, np.ndarray]] = {}
+        try:
+            for expected in schedule:
+                while expected not in stash:
+                    epoch, batch, columns = pool.get_results()
+                    stash[(epoch, batch)] = columns
+                columns = stash.pop(expected)
+                e, b = expected
+                # advance cursor BEFORE yielding: state saved while the
+                # consumer holds this batch points at the next one
+                self.epoch, self.batch = (e, b + 1) \
+                    if b + 1 < self.batches_per_epoch else (e + 1, 0)
+                yield columns
+        except EmptyResultError:
+            raise RuntimeError('worker pool drained before schedule finished')
+        finally:
+            pool.stop()
+            pool.join()
+
+
+def make_indexed_loader(dataset_url, batch_size, num_epochs=1, seed=0,
+                        shuffle=True, shuffle_window_groups=4,
+                        workers_count=4, prefetch_batches=8,
+                        schema_fields=None, storage_options=None,
+                        cache_groups=None):
+    """Factory: :class:`IndexedDatasetReader` + :class:`IndexedBatchLoader`."""
+    dataset = IndexedDatasetReader(
+        dataset_url, schema_fields=schema_fields,
+        storage_options=storage_options,
+        cache_groups=cache_groups or max(8, shuffle_window_groups + workers_count))
+    return IndexedBatchLoader(
+        dataset, batch_size, num_epochs=num_epochs, seed=seed, shuffle=shuffle,
+        shuffle_window_groups=shuffle_window_groups,
+        workers_count=workers_count, prefetch_batches=prefetch_batches)

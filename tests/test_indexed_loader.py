@@ -1,0 +1,144 @@
+"""Tests for the deterministic indexed loader and its O(1) exact resume
+(closes SURVEY §5.4 properly — the reference cannot resume mid-epoch at all,
+``reference reader.py:468-492``)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_indexed_loader
+from petastorm_tpu.codecs import ArrowListCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+from petastorm_tpu.indexed import IndexedDatasetReader, epoch_permutation
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+ROWS = 230
+
+IndexedSchema = Unischema('IndexedSchema', [
+    UnischemaField('idx', np.int64, (), ScalarCodec(), False),
+    UnischemaField('vec', np.float32, (5,), ArrowListCodec(), False),
+])
+
+
+@pytest.fixture(scope='module')
+def indexed_dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('indexed') / 'ds'
+    url = 'file://' + str(path)
+    rng = np.random.default_rng(0)
+    rows = [{'idx': np.int64(i),
+             'vec': rng.standard_normal(5).astype(np.float32)}
+            for i in range(ROWS)]
+    with materialize_dataset(url, IndexedSchema, row_group_size_mb=0.001) as w:
+        w.write_rows(rows)
+    return url, rows
+
+
+def _stream(loader, limit=None):
+    out = []
+    for i, batch in enumerate(loader):
+        out.append(batch)
+        if limit is not None and i + 1 >= limit:
+            break
+    return out
+
+
+class TestIndexedDataset:
+    def test_random_access_gather(self, indexed_dataset):
+        url, rows = indexed_dataset
+        ds = IndexedDatasetReader(url)
+        assert ds.total_rows == ROWS
+        assert len(ds.pieces) >= 4            # enough row groups to matter
+        want = np.asarray([7, 199, 0, 64, 7], np.int64)
+        got = ds.gather(want)
+        np.testing.assert_array_equal(got['idx'], want)
+        for j, i in enumerate(want):
+            np.testing.assert_array_equal(got['vec'][j], rows[i]['vec'])
+
+    def test_permutation_properties(self, indexed_dataset):
+        url, _ = indexed_dataset
+        ds = IndexedDatasetReader(url)
+        p1 = epoch_permutation(ds.total_rows, ds.row_offsets, seed=5, epoch=0)
+        p2 = epoch_permutation(ds.total_rows, ds.row_offsets, seed=5, epoch=0)
+        p3 = epoch_permutation(ds.total_rows, ds.row_offsets, seed=5, epoch=1)
+        np.testing.assert_array_equal(p1, p2)          # deterministic
+        assert not np.array_equal(p1, p3)              # varies by epoch
+        np.testing.assert_array_equal(np.sort(p1), np.arange(ROWS))  # bijection
+
+
+class TestIndexedLoader:
+    def test_epoch_covers_all_batched_rows_exactly_once(self, indexed_dataset):
+        url, _ = indexed_dataset
+        loader = make_indexed_loader(url, batch_size=32, num_epochs=1, seed=1)
+        batches = _stream(loader)
+        assert len(batches) == ROWS // 32
+        ids = np.concatenate([b['idx'] for b in batches])
+        assert len(np.unique(ids)) == len(ids)          # no duplicates
+
+    def test_stream_is_scheduling_independent(self, indexed_dataset):
+        url, _ = indexed_dataset
+        a = make_indexed_loader(url, batch_size=16, num_epochs=2, seed=3,
+                                workers_count=1)
+        b = make_indexed_loader(url, batch_size=16, num_epochs=2, seed=3,
+                                workers_count=4)
+        for ba, bb in zip(_stream(a), _stream(b)):
+            np.testing.assert_array_equal(ba['idx'], bb['idx'])
+            np.testing.assert_array_equal(ba['vec'], bb['vec'])
+
+    def test_kill_midepoch_restore_byte_identical(self, indexed_dataset):
+        """The VERDICT 'done' criterion: kill a thread-pool loader mid-epoch,
+        restore from the cursor, get the byte-identical remaining stream."""
+        url, _ = indexed_dataset
+        make = lambda: make_indexed_loader(url, batch_size=16, num_epochs=3,  # noqa: E731
+                                           seed=9, workers_count=4)
+
+        reference = _stream(make())                     # the full stream
+        victim = make()
+        consumed = 0
+        it = iter(victim)
+        for _ in range(10):                             # mid-epoch-2 (14/epoch)
+            next(it)
+            consumed += 1
+        state = victim.state_dict()
+        it.close()                                      # "kill" the loader
+
+        restored = make()
+        restored.load_state_dict(state)
+        rest = _stream(restored)
+        assert len(rest) == len(reference) - consumed
+        for got, want in zip(rest, reference[consumed:]):
+            np.testing.assert_array_equal(got['idx'], want['idx'])
+            np.testing.assert_array_equal(got['vec'], want['vec'])
+
+    def test_resume_across_epoch_boundary(self, indexed_dataset):
+        url, _ = indexed_dataset
+        make = lambda: make_indexed_loader(url, batch_size=16, num_epochs=2,  # noqa: E731
+                                           seed=4, workers_count=2)
+        reference = _stream(make())
+        per_epoch = ROWS // 16
+        victim = make()
+        it = iter(victim)
+        for _ in range(per_epoch):                      # exactly one epoch
+            next(it)
+        state = victim.state_dict()
+        it.close()
+        assert state == {'epoch': 1, 'batch': 0, 'version': 1}
+        restored = make()
+        restored.load_state_dict(state)
+        rest = _stream(restored)
+        assert len(rest) == per_epoch
+        for got, want in zip(rest, reference[per_epoch:]):
+            np.testing.assert_array_equal(got['idx'], want['idx'])
+
+    def test_no_shuffle_is_sequential(self, indexed_dataset):
+        url, _ = indexed_dataset
+        loader = make_indexed_loader(url, batch_size=32, num_epochs=1,
+                                     shuffle=False)
+        ids = np.concatenate([b['idx'] for b in _stream(loader)])
+        np.testing.assert_array_equal(ids, np.arange(len(ids)))
+
+    def test_state_roundtrips_through_json(self, indexed_dataset):
+        import json
+        url, _ = indexed_dataset
+        loader = make_indexed_loader(url, batch_size=32, num_epochs=1)
+        state = json.loads(json.dumps(loader.state_dict()))
+        loader.load_state_dict(state)
+        assert loader.state_dict() == state
