@@ -80,6 +80,17 @@ class LocalDiskCache(CacheBase):
 
     def _store(self, path: str, value) -> None:
         payload = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        # Overwrites replace the old entry's bytes: release them from the
+        # running total up front (passing a delta into _evict_if_needed would
+        # double-subtract if its rescan both lists the old file and applies
+        # the delta). The rescan still sees the not-yet-replaced file — a
+        # transient overcount that evicts conservatively and self-corrects.
+        try:
+            old_size = os.stat(path).st_size
+        except OSError:
+            old_size = 0
+        if old_size and self._approx_total is not None:
+            self._approx_total -= old_size
         self._evict_if_needed(len(payload))
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
         try:

@@ -130,18 +130,39 @@ def _normalize_precision(table: pa.Table, precision: Optional[str]) -> pa.Table:
 
 
 def _fingerprint(table: pa.Table, params: Dict) -> str:
-    """Content-addressed cache key: schema + shape + sampled column bytes +
-    materialization params."""
+    """Content-addressed cache key: schema + shape + ALL column bytes +
+    materialization params.
+
+    ALL data is hashed (not a prefix sample): two tables with identical
+    prefixes but different later data must not collide, or a stale
+    materialization would be silently reused. The data is streamed through
+    Arrow IPC rather than hashing raw chunk buffers — a sliced table shares
+    its parent's buffers, so raw-buffer hashing would collide slices at
+    different offsets; IPC serializes exactly the logical region. Hashing is
+    cheap relative to the parquet write it guards."""
     h = hashlib.sha256()
     h.update(table.schema.to_string().encode())
     h.update(str(table.num_rows).encode())
-    for name in table.column_names:
-        col = table.column(name)
-        for chunk in col.chunks[:4]:
-            head = chunk.slice(0, min(len(chunk), 1024))
-            for buf in head.buffers():
-                if buf is not None:
-                    h.update(bytes(buf)[:4096])
+
+    class _HashSink:
+        closed = False
+
+        @staticmethod
+        def write(data):
+            h.update(data)
+            return len(data)
+
+        @staticmethod
+        def flush():
+            pass
+
+        @staticmethod
+        def tell():
+            return 0
+
+    with pa.ipc.new_stream(pa.PythonFile(_HashSink(), mode='w'),
+                           table.schema) as writer:
+        writer.write_table(table)
     h.update(repr(sorted(params.items())).encode())
     return h.hexdigest()[:32]
 
@@ -306,10 +327,15 @@ def make_dataset_converter(data, parent_cache_dir_url: Optional[str] = None,
         warnings.warn('Materialized parquet files are very small; performance '
                       'may suffer (reference recommends >=50MB median)')
 
-    scheme = cache_dir_url.split('://', 1)[0]
-    saved = SavedDataset(cache_dir_url,
-                         ['{}://{}'.format(scheme, file_path)],
-                         table.num_rows, parent)
+    # Scheme-less cache dirs (a bare path, which fs.py accepts) must yield
+    # bare-path file urls — blindly prepending '<whole-path>://' produced
+    # unopenable urls.
+    if '://' in cache_dir_url:
+        scheme = cache_dir_url.split('://', 1)[0]
+        file_url = '{}://{}'.format(scheme, file_path)
+    else:
+        file_url = file_path
+    saved = SavedDataset(cache_dir_url, [file_url], table.num_rows, parent)
     with _cache_lock:
         _materialized[key] = saved
     if delete_at_exit:

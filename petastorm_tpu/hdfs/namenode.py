@@ -115,6 +115,14 @@ class HdfsNamenodeResolver(object):
         return [nameservice, namenodes]
 
 
+# OSError subclasses that describe the *request*, not the connection: a
+# missing file must surface as FileNotFoundError, not trigger namenode
+# reconnects and MaxFailoversExceeded (the reference only fails over on
+# connection-type ArrowIOError, namenode.py:181).
+_NON_FAILOVER_ERRORS = (FileNotFoundError, PermissionError, IsADirectoryError,
+                        NotADirectoryError, FileExistsError)
+
+
 def namenode_failover(func):
     """Retry a filesystem method across namenodes on connection errors
     (reference ``namenode_failover`` decorator, :146-186)."""
@@ -124,6 +132,8 @@ def namenode_failover(func):
         for _ in range(MAX_FAILOVER_ATTEMPTS + 1):
             try:
                 return func(self, *args, **kwargs)
+            except _NON_FAILOVER_ERRORS:
+                raise
             except (IOError, OSError) as e:
                 failures.append(e)
                 self._try_next_namenode()

@@ -37,7 +37,7 @@ from petastorm_tpu.readers.columnar_worker import _column_to_numpy
 from petastorm_tpu.unischema import match_unischema_fields
 from petastorm_tpu.workers import EmptyResultError
 from petastorm_tpu.workers.thread_pool import ThreadPool
-from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+from petastorm_tpu.workers.ventilator import BackPressuredVentilator
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 
@@ -81,19 +81,43 @@ class IndexedDatasetReader:
             collections.OrderedDict()
         self._cache_groups = cache_groups
         self._lock = threading.Lock()
-        self._files = {}
+        # parquet readers are NOT safe for concurrent reads on one instance:
+        # every pool thread gets its own handles (cf. readers/piece_worker.py)
+        self._local = threading.local()
+        self._open_files: List = []
 
     # -- io --------------------------------------------------------------------
 
     def _parquet_file(self, path: str):
         import pyarrow.parquet as pq
+        files = getattr(self._local, 'files', None)
+        if files is None:
+            files = self._local.files = {}
+        pf = files.get(path)
+        if pf is None:
+            handle = self._filesystem.open(path, 'rb')
+            pf = pq.ParquetFile(handle)
+            files[path] = pf
+            with self._lock:
+                self._open_files.append(handle)
+        return pf
+
+    def close(self):
+        """Close all parquet file handles opened by any thread."""
         with self._lock:
-            pf = self._files.get(path)
-        if pf is not None:
-            return pf
-        pf = pq.ParquetFile(self._filesystem.open(path, 'rb'))
-        with self._lock:
-            return self._files.setdefault(path, pf)
+            handles, self._open_files = self._open_files, []
+        self._local = threading.local()
+        for handle in handles:
+            try:
+                handle.close()
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.close()
 
     def read_piece(self, piece_index: int) -> Dict[str, np.ndarray]:
         with self._lock:
@@ -165,6 +189,39 @@ def epoch_permutation(total_rows: int, row_offsets: np.ndarray, seed, epoch: int
         rng.shuffle(idx)
         out.append(idx)
     return np.concatenate(out) if out else np.empty(0, np.int64)
+
+
+def _next_cursor(epoch: int, batch: int, batches_per_epoch: int):
+    """The (epoch, batch) grid successor — single source of truth for the
+    ventilator, the consumer's expected order, and the checkpoint cursor."""
+    batch += 1
+    return (epoch, batch) if batch < batches_per_epoch else (epoch + 1, 0)
+
+
+class _ScheduleVentilator(BackPressuredVentilator):
+    """Lazily ventilates the (epoch, batch) grid from a cursor.
+
+    O(1) memory regardless of ``num_epochs x batches_per_epoch`` — a
+    materialized schedule (list of tuples + list of kwargs dicts) for a large
+    dataset over many epochs would be gigabytes of resident Python objects
+    before the first batch is produced."""
+
+    def __init__(self, ventilate_fn, start_epoch: int, start_batch: int,
+                 num_epochs: int, batches_per_epoch: int, max_in_flight: int):
+        super().__init__(ventilate_fn, max_in_flight=max_in_flight)
+        self._start = (start_epoch, start_batch)
+        self._num_epochs = num_epochs
+        self._bpe = batches_per_epoch
+        if start_epoch >= num_epochs:
+            self._completed.set()
+
+    def _ventilate_loop(self):
+        e, b = self._start
+        while e < self._num_epochs and not self._stop_event.is_set():
+            if not self._acquire_slot():
+                return
+            self._ventilate_fn(epoch=e, batch=b)
+            e, b = _next_cursor(e, b, self._bpe)
 
 
 class _IndexedBatchWorker(WorkerBase):
@@ -266,25 +323,36 @@ class IndexedBatchLoader:
 
     # -- iteration -------------------------------------------------------------
 
+    def close(self):
+        """Close the underlying dataset's parquet handles (reopened lazily on
+        any later read, so closing is always safe)."""
+        self._dataset.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.close()
+
+    def _schedule(self, start_epoch, start_batch):
+        e, b = start_epoch, start_batch
+        while e < self.num_epochs:
+            yield e, b
+            e, b = _next_cursor(e, b, self.batches_per_epoch)
+
     def __iter__(self):
-        schedule = [(e, b)
-                    for e in range(self.epoch, self.num_epochs)
-                    for b in range(self.batch if e == self.epoch else 0,
-                                   self.batches_per_epoch)]
-        if not schedule:
+        if self.epoch >= self.num_epochs:
             return
         pool = ThreadPool(self.workers_count,
                           results_queue_size=self.prefetch_batches)
-        ventilator = ConcurrentVentilator(
-            pool.ventilate,
-            [{'epoch': e, 'batch': b} for e, b in schedule],
-            iterations=1, randomize_item_order=False,
-            max_ventilation_queue_size=self.workers_count
-            + self.prefetch_batches)
+        ventilator = _ScheduleVentilator(
+            pool.ventilate, self.epoch, self.batch, self.num_epochs,
+            self.batches_per_epoch,
+            max_in_flight=self.workers_count + self.prefetch_batches)
         pool.start(_IndexedBatchWorker, {'loader': self}, ventilator)
         stash: Dict[tuple, Dict[str, np.ndarray]] = {}
         try:
-            for expected in schedule:
+            for expected in self._schedule(self.epoch, self.batch):
                 while expected not in stash:
                     epoch, batch, columns = pool.get_results()
                     stash[(epoch, batch)] = columns
@@ -292,14 +360,17 @@ class IndexedBatchLoader:
                 e, b = expected
                 # advance cursor BEFORE yielding: state saved while the
                 # consumer holds this batch points at the next one
-                self.epoch, self.batch = (e, b + 1) \
-                    if b + 1 < self.batches_per_epoch else (e + 1, 0)
+                self.epoch, self.batch = _next_cursor(
+                    e, b, self.batches_per_epoch)
                 yield columns
         except EmptyResultError:
             raise RuntimeError('worker pool drained before schedule finished')
         finally:
             pool.stop()
             pool.join()
+            # worker threads are gone; release the fds they opened (the next
+            # iteration's fresh threads open their own)
+            self._dataset.close()
 
 
 def make_indexed_loader(dataset_url, batch_size, num_epochs=1, seed=0,
@@ -311,7 +382,8 @@ def make_indexed_loader(dataset_url, batch_size, num_epochs=1, seed=0,
     dataset = IndexedDatasetReader(
         dataset_url, schema_fields=schema_fields,
         storage_options=storage_options,
-        cache_groups=cache_groups or max(8, shuffle_window_groups + workers_count))
+        cache_groups=(cache_groups if cache_groups is not None
+                      else max(8, shuffle_window_groups + workers_count)))
     return IndexedBatchLoader(
         dataset, batch_size, num_epochs=num_epochs, seed=seed, shuffle=shuffle,
         shuffle_window_groups=shuffle_window_groups,

@@ -91,7 +91,10 @@ def make_pipeline_fn(stage_fn, mesh, pipe_axis: str = 'pipe',
         def run(stacked, mb):
             # squeeze this stage's slot of the stacked params
             my_params = jax.tree_util.tree_map(lambda a: a[0], stacked)
-            mb = jax.lax.pvary(mb, (pipe_axis,))
+            if hasattr(jax.lax, 'pcast'):
+                mb = jax.lax.pcast(mb, (pipe_axis,), to='varying')
+            else:  # pre-pcast jax: pvary is the (now deprecated) spelling
+                mb = jax.lax.pvary(mb, (pipe_axis,))
             return pipeline_apply(stage_fn, my_params, mb, pipe_axis)
 
         return run(stacked_params, microbatches)

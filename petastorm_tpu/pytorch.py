@@ -206,6 +206,10 @@ class BatchedDataLoader(LoaderBase):
             if arr.dtype.kind in ('U', 'S', 'O'):
                 out[k] = v
             else:
+                # torch cannot represent non-writable tensors; arrow's
+                # zero-copy numpy views are read-only, so copy at the boundary
+                if not arr.flags.writeable:
+                    arr = arr.copy()
                 out[k] = torch.as_tensor(arr)
         return out
 

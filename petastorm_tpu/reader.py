@@ -13,7 +13,7 @@ TPU-first deviations:
    ``shard_by_jax_process=True`` is passed (multi-host pods read disjoint
    row-group shards; see SURVEY.md §2 "Parallelism accounting").
  - The reader never touches the TPU: it produces numpy/namedtuple rows.
-   Device staging lives in :mod:`petastorm_tpu.jaxio`.
+   Device staging lives in :mod:`petastorm_tpu.jax_utils`.
 """
 
 from __future__ import annotations

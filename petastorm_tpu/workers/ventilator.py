@@ -43,7 +43,71 @@ class Ventilator(ABC):
         """Stop ventilating."""
 
 
-class ConcurrentVentilator(Ventilator):
+class BackPressuredVentilator(Ventilator):
+    """Shared machinery for daemon-thread ventilators with bounded in-flight
+    items: slot accounting, stop/done events, thread lifecycle. Subclasses
+    implement :meth:`_ventilate_loop`, calling :meth:`_acquire_slot` before
+    each :attr:`_ventilate_fn` call and returning when done (or when
+    ``_acquire_slot`` returns False on stop)."""
+
+    def __init__(self, ventilate_fn, max_in_flight: int,
+                 interval_s: float = 0.01):
+        super().__init__(ventilate_fn)
+        self._max_in_flight = max_in_flight
+        self._interval = interval_s
+        self._in_flight = 0
+        self._in_flight_lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._completed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError('Ventilator already started')
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name='petastorm-tpu-ventilator')
+        self._thread.start()
+
+    def _run(self):
+        self._ventilate_loop()
+        self._completed.set()
+
+    def _ventilate_loop(self):
+        raise NotImplementedError
+
+    def _acquire_slot(self) -> bool:
+        """Block until an in-flight slot frees up; False if stopped."""
+        while not self._stop_event.is_set():
+            with self._in_flight_lock:
+                if self._in_flight < self._max_in_flight:
+                    self._in_flight += 1
+                    return True
+            time.sleep(self._interval)
+        return False
+
+    def processed_item(self):
+        with self._in_flight_lock:
+            self._in_flight -= 1
+
+    def completed(self) -> bool:
+        # All items ventilated AND nothing still in flight.
+        if not self._completed.is_set():
+            return False
+        with self._in_flight_lock:
+            return self._in_flight == 0
+
+    def fully_ventilated(self) -> bool:
+        """True once every item was handed to the pool (some may be in flight)."""
+        return self._completed.is_set()
+
+    def stop(self):
+        self._stop_event.set()
+        self._completed.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class ConcurrentVentilator(BackPressuredVentilator):
     """Ventilates a fixed item list from a daemon thread.
 
     :param ventilate_fn: ``pool.ventilate``-compatible callable.
@@ -62,23 +126,18 @@ class ConcurrentVentilator(Ventilator):
                  max_ventilation_queue_size: Optional[int] = None,
                  ventilation_interval_s: float = 0.01,
                  start_epoch: int = 0):
-        super().__init__(ventilate_fn)
         if iterations is not None and iterations < 1:
             raise ValueError('iterations must be positive or None, got {}'.format(iterations))
-        self._items = list(items)
+        items = list(items)
+        super().__init__(ventilate_fn,
+                         max_in_flight=max_ventilation_queue_size or len(items) or 1,
+                         interval_s=ventilation_interval_s)
+        self._items = items
         self._iterations_remaining = iterations
         self._randomize_item_order = randomize_item_order
         self._rng = np.random.default_rng(random_seed)
         self._random_seed = random_seed
-        self._max_queue_size = max_ventilation_queue_size or len(self._items) or 1
-        self._interval = ventilation_interval_s
         self._epoch = start_epoch
-
-        self._in_flight = 0
-        self._in_flight_lock = threading.Lock()
-        self._stop_event = threading.Event()
-        self._completed = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         if not self._items:
             self._completed.set()
 
@@ -86,13 +145,6 @@ class ConcurrentVentilator(Ventilator):
     def epoch(self) -> int:
         """Epochs fully ventilated so far (checkpointable progress marker)."""
         return self._epoch
-
-    def start(self):
-        if self._thread is not None:
-            raise RuntimeError('Ventilator already started')
-        self._thread = threading.Thread(target=self._ventilate_loop, daemon=True,
-                                        name='petastorm-tpu-ventilator')
-        self._thread.start()
 
     def _ventilate_loop(self):
         while not self._stop_event.is_set():
@@ -105,34 +157,12 @@ class ConcurrentVentilator(Ventilator):
                 order = list(self._items)
                 self._rng.shuffle(order)
             for item in order:
-                while not self._stop_event.is_set():
-                    with self._in_flight_lock:
-                        if self._in_flight < self._max_queue_size:
-                            self._in_flight += 1
-                            break
-                    time.sleep(self._interval)
-                if self._stop_event.is_set():
+                if not self._acquire_slot():
                     return
                 self._ventilate_fn(**item) if isinstance(item, dict) else self._ventilate_fn(item)
             self._epoch += 1
             if self._iterations_remaining is not None:
                 self._iterations_remaining -= 1
-        self._completed.set()
-
-    def processed_item(self):
-        with self._in_flight_lock:
-            self._in_flight -= 1
-
-    def completed(self) -> bool:
-        # All epochs ventilated AND nothing still in flight.
-        if not self._completed.is_set():
-            return False
-        with self._in_flight_lock:
-            return self._in_flight == 0
-
-    def fully_ventilated(self) -> bool:
-        """True once all epochs were handed to the pool (items may still be in flight)."""
-        return self._completed.is_set()
 
     def reset(self, iterations: Optional[int] = 1):
         """Restart ventilation for more epochs; only legal after completion
@@ -146,9 +176,3 @@ class ConcurrentVentilator(Ventilator):
             self._completed.set()
         self._thread = None
         self.start()
-
-    def stop(self):
-        self._stop_event.set()
-        self._completed.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)

@@ -56,6 +56,28 @@ class TestMaterialization:
         s2 = make_dataset_converter(_table(), compression='snappy')
         assert s1.cache_dir_url != s2.cache_dir_url
 
+    def test_cache_miss_on_suffix_only_difference(self):
+        # Same schema/row count/prefix, divergence only in later rows: a
+        # prefix-sampled fingerprint would collide and silently reuse stale
+        # data (advisor finding, converter.py _fingerprint).
+        n = 50_000
+        base = np.arange(n, dtype=np.int64)
+        tail_changed = base.copy()
+        tail_changed[-1] = -1
+        s1 = make_dataset_converter(pa.table({'id': base}))
+        s2 = make_dataset_converter(pa.table({'id': tail_changed}))
+        assert s1.cache_dir_url != s2.cache_dir_url
+
+    def test_schemeless_cache_dir(self, tmp_path):
+        # A bare-path cache dir (PETASTORM_TPU_CACHE_DIR=/tmp/x form) must
+        # produce openable file urls (advisor finding: '<path>://<path>/...').
+        saved = make_dataset_converter(
+            _table(), parent_cache_dir_url=str(tmp_path / 'bare_cache'))
+        assert all('://' not in u for u in saved.file_urls)
+        with saved.make_jax_loader(batch_size=10, num_epochs=1) as loader:
+            batches = list(loader)
+        assert sum(len(b['id']) for b in batches) == 100
+
     def test_precision_float32(self):
         saved = make_dataset_converter(_table(), precision='float32')
         with saved.make_jax_loader(batch_size=10, num_epochs=1,

@@ -89,6 +89,26 @@ class TestHAFailover:
         with pytest.raises(MaxFailoversExceeded):
             client.ls('/x')
 
+    def test_request_errors_do_not_fail_over(self):
+        # FileNotFoundError/PermissionError describe the request, not the
+        # connection: they must surface immediately instead of burning
+        # namenode failovers (advisor finding; reference namenode.py:181
+        # only retries connection-type errors).
+        class _MissingFileFs(object):
+            connects = 0
+
+            def __init__(self, host):
+                _MissingFileFs.connects += 1
+
+            def ls(self, path):
+                raise FileNotFoundError(path)
+
+        client = HAHdfsClient(_MissingFileFs, ['nn1:8020', 'nn2:8020'])
+        connects_after_init = _MissingFileFs.connects
+        with pytest.raises(FileNotFoundError):
+            client.ls('/missing')
+        assert _MissingFileFs.connects == connects_after_init  # no reconnects
+
 
 class TestBatchingTableQueue:
     def test_rechunks(self):

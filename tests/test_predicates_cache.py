@@ -70,6 +70,18 @@ class TestLocalDiskCache:
             cache.get('key_{}'.format(i), lambda i=i: np.full(1000, i))
         assert cache.size_bytes() <= 60_000  # approximately bounded
 
+    def test_overwrite_does_not_double_count(self, tmp_path):
+        # Overwriting a key must account only the size delta, not re-add the
+        # full payload (advisor finding: premature eviction scans).
+        cache = LocalDiskCache(str(tmp_path), size_limit_bytes=1 << 20)
+        value = np.arange(1000)
+        path = cache._key_path('k')
+        cache._store(path, value)
+        total_after_first = cache._approx_total
+        for _ in range(10):
+            cache._store(path, value)
+        assert cache._approx_total == total_after_first
+
     def test_corrupt_entry_refilled(self, tmp_path):
         cache = LocalDiskCache(str(tmp_path), 1 << 20)
         cache.get('k', lambda: 42)
