@@ -152,16 +152,7 @@ def _fingerprint(table: pa.Table, params: Dict) -> str:
             h.update(data)
             return len(data)
 
-        @staticmethod
-        def flush():
-            pass
-
-        @staticmethod
-        def tell():
-            return 0
-
-    with pa.ipc.new_stream(pa.PythonFile(_HashSink(), mode='w'),
-                           table.schema) as writer:
+    with pa.ipc.new_stream(_HashSink(), table.schema) as writer:
         writer.write_table(table)
     h.update(repr(sorted(params.items())).encode())
     return h.hexdigest()[:32]

@@ -96,7 +96,11 @@ class IndexedDatasetReader:
         pf = files.get(path)
         if pf is None:
             handle = self._filesystem.open(path, 'rb')
-            pf = pq.ParquetFile(handle)
+            try:
+                pf = pq.ParquetFile(handle)
+            except Exception:
+                handle.close()   # bad footer etc. must not leak the fd
+                raise
             files[path] = pf
             with self._lock:
                 self._open_files.append(handle)
@@ -368,9 +372,12 @@ class IndexedBatchLoader:
         finally:
             pool.stop()
             pool.join()
-            # worker threads are gone; release the fds they opened (the next
-            # iteration's fresh threads open their own)
-            self._dataset.close()
+            # release the fds the worker threads opened (the next iteration's
+            # fresh threads open their own) — but only once the threads are
+            # really gone: join() times out rather than verifying exit, and
+            # closing a file under a zombie reader corrupts its last read
+            if not any(t.is_alive() for t in getattr(pool, '_threads', [])):
+                self._dataset.close()
 
 
 def make_indexed_loader(dataset_url, batch_size, num_epochs=1, seed=0,

@@ -162,6 +162,82 @@ class TestTransformerLm:
         assert np.isfinite(float(loss))
 
 
+class TestMoeDispatch:
+    def _layer_and_x(self, cfg, rng_seed=0, batch=2, seq=16):
+        from petastorm_tpu.models import transformer_lm as tlm
+        params = tlm.init(jax.random.PRNGKey(rng_seed), cfg)
+        layer = params['layers'][0]
+        rng = np.random.default_rng(rng_seed)
+        x = jnp.asarray(rng.standard_normal((batch, seq, cfg.d_model)) * 0.3,
+                        jnp.float32)
+        return layer, x
+
+    def test_sparse_matches_dense_oracle_with_ample_capacity(self, cpus):
+        # capacity_factor = n_experts → capacity = n tokens: nothing can be
+        # dropped, so sort/scatter dispatch must reproduce the dense one-hot
+        # oracle exactly
+        from petastorm_tpu.models import transformer_lm as tlm
+        cfg = _tiny_config(n_experts=4, moe_capacity_factor=4.0)
+        layer, x = self._layer_and_x(cfg)
+        with jax.default_device(cpus[0]):
+            sparse = tlm._moe_ffn(x, layer, cfg)
+            dense = tlm._moe_ffn_dense(x, layer, cfg)
+        np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense),
+                                   atol=1e-5)
+
+    def test_over_capacity_tokens_pass_through_as_zeros(self, cpus):
+        from petastorm_tpu.models import transformer_lm as tlm
+        # capacity 1 with 32 tokens over 2 experts: nearly all tokens dropped
+        cfg = _tiny_config(n_experts=2, moe_capacity_factor=2 * 1.0 / 32)
+        layer, x = self._layer_and_x(cfg)
+        with jax.default_device(cpus[0]):
+            out = np.asarray(tlm._moe_ffn(x, layer, cfg))
+        flat = out.reshape(-1, cfg.d_model)
+        zero_rows = np.all(flat == 0.0, axis=1).sum()
+        assert zero_rows >= flat.shape[0] - 2    # ≤1 kept per expert
+
+    def test_flops_independent_of_expert_count(self, cpus):
+        # The cost analysis must show per-token FLOPs ~constant in E: the
+        # dense one-hot dispatch scaled linearly (VERDICT weak-item 6).
+        from petastorm_tpu.models import transformer_lm as tlm
+
+        def moe_flops(n_experts):
+            cfg = _tiny_config(n_experts=n_experts, moe_capacity_factor=1.0)
+            layer, x = self._layer_and_x(cfg)
+            fn = jax.jit(lambda x: tlm._moe_ffn(x, layer, cfg))
+            return fn.lower(x).compile().cost_analysis()['flops']
+
+        f2, f8 = moe_flops(2), moe_flops(8)
+        assert f8 < f2 * 1.5, (f2, f8)   # dense dispatch would give ~4x
+
+    def test_grad_flows_and_sharded_step_runs(self, cpus):
+        from jax.sharding import NamedSharding, PartitionSpec
+        from petastorm_tpu.models import transformer_lm as tlm
+        from petastorm_tpu.parallel import make_mesh
+        cfg = _tiny_config(n_experts=4)
+        mesh = make_mesh({'data': 2, 'expert': 4}, devices=cpus[:8])
+        params = tlm.init(jax.random.PRNGKey(0), cfg)
+        pspecs = tlm.param_specs(cfg, mesh)
+        p_shard = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), pspecs,
+            is_leaf=lambda s: isinstance(s, PartitionSpec))
+        params = jax.tree_util.tree_map(jax.device_put, params, p_shard)
+        optimizer, step = tlm.make_train_step(cfg, mesh)
+        opt_state = optimizer.init(params)
+        rng = np.random.default_rng(0)
+        b_shard = NamedSharding(mesh, tlm.batch_spec(mesh))
+        toks = jax.device_put(jnp.asarray(
+            rng.integers(0, 64, (4, 32)), jnp.int32), b_shard)
+        tgts = jax.device_put(jnp.asarray(
+            rng.integers(0, 64, (4, 32)), jnp.int32), b_shard)
+        params2, _, loss1 = step(params, opt_state, toks, tgts)
+        assert np.isfinite(float(loss1))
+        # gate gradient reached the router (params actually changed)
+        g0 = np.asarray(params['layers'][0]['gate'])
+        g1 = np.asarray(params2['layers'][0]['gate'])
+        assert not np.allclose(g0, g1)
+
+
 class TestGraftEntry:
     def test_entry_and_dryrun(self, cpus):
         import importlib.util
