@@ -42,6 +42,11 @@ class NGram:
         self._delta_threshold = delta_threshold
         self._timestamp_field = timestamp_field
         self._timestamp_overlap = timestamp_overlap
+        # offset -> (schema, view); avoids rebuilding the view (and its
+        # namedtuple class) per window. Identity-checked against the schema so
+        # a different schema never gets a stale view; dropped on pickle (the
+        # namedtuple classes are not picklable)
+        self._view_cache: Dict = {}
 
     @property
     def fields(self) -> Dict[int, List]:
@@ -108,9 +113,15 @@ class NGram:
                 return False
         return True
 
-    def form_ngram(self, data: List[dict], schema: Unischema) -> List[Dict[int, object]]:
+    def form_ngram_dicts(self, data: List[dict],
+                         schema: Unischema) -> List[Dict[int, dict]]:
         """Scan timestamp-sorted rows and emit all valid windows as
-        ``{offset: namedtuple}`` dicts (reference ``ngram.py:225-270``)."""
+        ``{offset: {field: value}}`` dicts (reference ``ngram.py:225-270``).
+
+        Plain dicts, not namedtuples: this runs on pool WORKERS, and the
+        dynamically generated namedtuple classes of schema views cannot be
+        unpickled on the consumer side of a process pool. Namedtuple assembly
+        happens consumer-side in :meth:`make_namedtuples`."""
         ts_name = self.timestamp_field_name
         rows = sorted(data, key=lambda r: r[ts_name])
         offsets = sorted(self._fields.keys())
@@ -125,9 +136,34 @@ class NGram:
                 continue
             ngram = {}
             for offset, row in zip(offsets, window):
-                view = self.get_schema_at_timestep(schema, offset)
-                ngram[offset] = view.make_namedtuple(
-                    **{name: row[name] for name in view.fields})
+                view = self._timestep_view(schema, offset)
+                ngram[offset] = {name: row[name] for name in view.fields}
             ngrams.append(ngram)
             previous_window_end_ts = window[-1][ts_name]
         return ngrams
+
+    def _timestep_view(self, schema: Unischema, offset: int) -> Unischema:
+        cached = self._view_cache.get(offset)
+        if cached is not None and cached[0] is schema:
+            return cached[1]
+        view = self.get_schema_at_timestep(schema, offset)
+        self._view_cache[offset] = (schema, view)
+        return view
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state['_view_cache'] = {}
+        return state
+
+    def make_namedtuples(self, window: Dict[int, dict],
+                         schema: Unischema) -> Dict[int, object]:
+        """Consumer-side: convert one dict window into per-timestep schema-view
+        namedtuples."""
+        return {offset: self._timestep_view(schema, offset).make_namedtuple(**row)
+                for offset, row in window.items()}
+
+    def form_ngram(self, data: List[dict], schema: Unischema) -> List[Dict[int, object]]:
+        """Windows as ``{offset: namedtuple}`` — single-process convenience
+        composing :meth:`form_ngram_dicts` + :meth:`make_namedtuples`."""
+        return [self.make_namedtuples(w, schema)
+                for w in self.form_ngram_dicts(data, schema)]

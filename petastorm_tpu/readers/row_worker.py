@@ -42,7 +42,10 @@ class RowGroupResultsReader:
             self._buffer = list(pool.get_results())
         item = self._buffer.pop()
         if self._ngram:
-            return item  # already {offset: namedtuple}
+            # workers ship windows as plain dicts (namedtuple classes of
+            # schema views cannot cross the process-pool pickle boundary);
+            # assemble the per-timestep namedtuples here on the consumer
+            return self._ngram.make_namedtuples(item, self._schema)
         return self._schema.make_namedtuple(**item)
 
 
@@ -66,7 +69,7 @@ class RowGroupWorker(ParquetPieceWorker):
         if self._transform_spec is not None:
             rows = [self._apply_transform(r) for r in rows]
         if self._ngram is not None:
-            rows = self._ngram.form_ngram(rows, self._transformed_schema)
+            rows = self._ngram.form_ngram_dicts(rows, self._transformed_schema)
         if rows:
             self.publish_func(rows)
 

@@ -70,6 +70,27 @@ def non_petastorm_dataset(tmp_path_factory):
     return SyntheticDataset(url=url, path=str(path), data=data)
 
 
+@pytest.fixture(scope='session')
+def wide_dataset(tmp_path_factory):
+    """1000-column int32 parquet store (reference's
+    ``many_columns_non_petastorm_dataset``, ``tests/conftest.py:89-138``):
+    stresses schema inference, column projection and row assembly at width."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    path = tmp_path_factory.mktemp('wide') / 'dataset'
+    path.mkdir(parents=True)
+    n_cols, n_rows = 1000, 60
+    # col_k[row r] = r * 1000 + k — every cell value is position-determined
+    data = {'col_{:04d}'.format(k):
+            np.arange(n_rows, dtype=np.int32) * 1000 + k
+            for k in range(n_cols)}
+    pq.write_table(pa.table(data), str(path / 'part_0.parquet'),
+                   row_group_size=20)
+    return SyntheticDataset(url='file://' + str(path), path=str(path),
+                            data={'n_cols': n_cols, 'n_rows': n_rows})
+
+
 class SyntheticDataset:
     def __init__(self, url, path, data):
         self.url = url

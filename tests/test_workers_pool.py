@@ -167,3 +167,47 @@ def test_diagnostics_surface():
     assert {'items_consumed', 'items_produced', 'items_inprocess'} <= set(d)
     pool.stop()
     pool.join()
+
+
+def test_process_pool_backpressure_with_stalled_consumer():
+    """With a stalled consumer, in-flight work stays bounded by the
+    ventilation queue size instead of racing through the whole item list
+    (reference back-pressure behavior, ``tests/test_reader.py:58-70``)."""
+    import time
+    pool = ProcessPool(2)
+    vent = ConcurrentVentilator(pool.ventilate,
+                                [{'x': i} for i in range(200)],
+                                iterations=1, max_ventilation_queue_size=5)
+    pool.start(SquareWorker, ventilator=vent)
+    try:
+        # consume nothing; give workers ample time to run ahead if they could.
+        # items_inprocess counts VENTILATED-not-yet-acknowledged items and
+        # moves without any get_results call (items_produced does not), so the
+        # bound is falsifiable: a ventilator ignoring the queue size would
+        # push it toward 200 here.
+        deadline = time.monotonic() + 2.0
+        seen = 0
+        while time.monotonic() < deadline:
+            seen = max(seen, pool.diagnostics['items_inprocess'])
+            assert seen <= 5, seen
+            time.sleep(0.1)
+        assert seen > 0          # ventilation did start
+        # draining releases slots and the remaining items flow
+        results = drain(pool)
+        assert sorted(results) == sorted(i * i for i in range(200))
+    finally:
+        pool.stop()
+        pool.join()
+
+
+def test_process_pool_get_results_timeout():
+    pool = ProcessPool(1)
+    vent = ConcurrentVentilator(pool.ventilate, [{'x': 1, 'count': 0}],
+                                iterations=None)   # worker never publishes
+    pool.start(MultiEmitWorker, ventilator=vent)
+    try:
+        with pytest.raises(TimeoutWaitingForResultError):
+            pool.get_results(timeout=1.0)
+    finally:
+        pool.stop()
+        pool.join()
