@@ -13,7 +13,9 @@ pyarrow-native, no JVM.
 
 from __future__ import annotations
 
+import inspect
 import io
+import re
 from abc import ABC, abstractmethod
 from typing import Any, Dict, Type
 
@@ -114,6 +116,41 @@ def _check_dtype(field, value: np.ndarray):
             field.name, field.numpy_dtype, value.dtype))
 
 
+# Strict matcher for the header np.save itself generates. np.load parses this
+# dict with ast.literal_eval (compile + AST walk) on EVERY cell — ~8% of a
+# decode-bound reader's CPU in profiles. Payloads matching this exact
+# machine-generated form take the fast path; anything else (fortran order,
+# structured/object dtypes, hand-crafted files) falls back to np.load.
+_NPY_FAST_HEADER = re.compile(
+    rb"^\{'descr': '([<>=|][a-zA-Z]\d*)', 'fortran_order': False, "
+    rb"'shape': \((\d*(?:, ?\d+)*,?)\), \}\s*$")
+
+
+def _fast_npy_decode(value: bytes):
+    """Decode an ``np.save`` payload without ast-based header parsing;
+    returns None when the payload is not in the standard v1 form.
+
+    Returns a WRITABLE array (one memcpy), matching what ``np.load`` gives
+    consumers on the fallback path — an in-place transform must not work for
+    one serialization form and crash for another."""
+    # magic \x93NUMPY, version (1,0), little-endian u2 header length
+    if len(value) < 10 or value[:8] != b'\x93NUMPY\x01\x00':
+        return None
+    hlen = value[8] | (value[9] << 8)
+    header_end = 10 + hlen
+    m = _NPY_FAST_HEADER.match(value[10:header_end])
+    if m is None:
+        return None
+    dtype = np.dtype(m.group(1).decode())
+    if dtype.hasobject:          # pickled payload — np.load territory
+        return None
+    shape_src = m.group(2)
+    shape = tuple(int(p) for p in shape_src.replace(b' ', b'').split(b',') if p) \
+        if shape_src else ()
+    flat = np.frombuffer(value, dtype=dtype, offset=header_end)
+    return flat.reshape(shape).copy()
+
+
 @register_codec
 class NdarrayCodec(DataframeColumnCodec):
     """Lossless ndarray <-> bytes via ``np.save`` (reference ``codecs.py:133-171``)."""
@@ -128,6 +165,9 @@ class NdarrayCodec(DataframeColumnCodec):
         return memfile.getvalue()
 
     def decode(self, unischema_field, value):
+        fast = _fast_npy_decode(value)
+        if fast is not None:
+            return fast
         memfile = io.BytesIO(value)
         return np.load(memfile)
 
@@ -237,9 +277,49 @@ class CompressedImageCodec(DataframeColumnCodec):
         return contents.tobytes()
 
     def decode(self, unischema_field, value):
+        return self._decode_flag(unischema_field, value, None)
+
+    def decode_scaled(self, unischema_field, value, min_shape,
+                      allow_upscale=False):
+        """Decode at reduced resolution when the consumer will downscale
+        anyway: picks the largest jpeg DCT denominator (2/4/8, applied during
+        entropy decode — substantially cheaper than decode-then-resize) whose
+        output still covers ``min_shape`` (or, with ``allow_upscale``, stays
+        within one halving of it). Needs the field's stored shape to be fully
+        known; otherwise falls back to a full decode. TPU-first addition (the
+        reference always decodes at full resolution); same trick as
+        torchvision's ``decode_jpeg(..., size=...)``."""
+        import cv2
+        shape = unischema_field.shape
+        # REDUCED_* flags force 8-bit 3-channel (or 8-bit gray): anything the
+        # reduced decode cannot represent faithfully — uint16 png, RGBA —
+        # must take the full-resolution path rather than silently degrade
+        representable = (
+            np.dtype(unischema_field.numpy_dtype) == np.uint8
+            and (shape is None or len(shape) == 2
+                 or (len(shape) == 3 and shape[2] == 3)))
+        if (min_shape is None or not representable or shape is None
+                or len(shape) < 2 or any(s is None for s in shape[:2])):
+            return self.decode(unischema_field, value)
+        min_h, min_w = int(min_shape[0]), int(min_shape[1])
+        color = len(shape) > 2
+        flags = {2: cv2.IMREAD_REDUCED_COLOR_2 if color else cv2.IMREAD_REDUCED_GRAYSCALE_2,
+                 4: cv2.IMREAD_REDUCED_COLOR_4 if color else cv2.IMREAD_REDUCED_GRAYSCALE_4,
+                 8: cv2.IMREAD_REDUCED_COLOR_8 if color else cv2.IMREAD_REDUCED_GRAYSCALE_8}
+        chosen = None
+        for denom in (8, 4, 2):
+            h, w = -(-shape[0] // denom), -(-shape[1] // denom)
+            if (h >= min_h and w >= min_w) or \
+                    (allow_upscale and 2 * h >= min_h and 2 * w >= min_w):
+                chosen = flags[denom]
+                break
+        return self._decode_flag(unischema_field, value, chosen)
+
+    def _decode_flag(self, unischema_field, value, flag):
         import cv2
         image_bgr_or_gray = cv2.imdecode(
-            np.frombuffer(value, dtype=np.uint8), cv2.IMREAD_UNCHANGED)
+            np.frombuffer(value, dtype=np.uint8),
+            cv2.IMREAD_UNCHANGED if flag is None else flag)
         if image_bgr_or_gray is None:
             raise ValueError('cv2.imdecode failed for field {!r}'.format(unischema_field.name))
         if image_bgr_or_gray.ndim == 3 and image_bgr_or_gray.shape[2] == 3:
@@ -318,3 +398,35 @@ class ScalarCodec(DataframeColumnCodec):
 
     def __repr__(self):
         return 'ScalarCodec({})'.format(self._dtype if self._dtype is not None else '')
+
+
+def build_decode_overrides(schema, decode_hints):
+    """``{field: callable(value)}`` from reader-level decode hints.
+
+    ``decode_hints`` maps field name -> kwargs for the codec's
+    ``decode_scaled`` (e.g. ``{'image': {'min_shape': (112, 112)}}``).
+    Validates at reader construction that every hinted field exists and its
+    codec supports scaled decoding. Built inside workers from the plain hint
+    dicts so nothing unpicklable crosses the pool boundary."""
+    if not decode_hints:
+        return {}
+    overrides = {}
+    for name, hint in decode_hints.items():
+        field = schema.fields.get(name)
+        if field is None:
+            raise ValueError('decode_hints names unknown field {!r}'.format(name))
+        scaled = getattr(field.codec, 'decode_scaled', None)
+        if scaled is None:
+            raise ValueError(
+                'decode_hints for field {!r}: codec {!r} has no decode_scaled'
+                .format(name, type(field.codec).__name__))
+        try:      # typo'd kwargs must fail here, not per-cell inside workers
+            inspect.signature(scaled).bind(field, b'', **hint)
+        except TypeError as e:
+            raise ValueError(
+                'decode_hints for field {!r} do not match {}.decode_scaled: {}'
+                .format(name, type(field.codec).__name__, e))
+        def _decode(value, _fn=scaled, _field=field, _kw=dict(hint)):
+            return _fn(_field, value, **_kw)
+        overrides[name] = _decode
+    return overrides

@@ -23,6 +23,7 @@ import warnings
 from typing import List, Optional
 
 from petastorm_tpu.cache import LocalDiskCache, NullCache
+from petastorm_tpu.codecs import build_decode_overrides
 from petastorm_tpu.errors import NoDataAvailableError, PetastormMetadataError
 from petastorm_tpu.etl.dataset_metadata import (get_schema, infer_or_load_unischema,
                                                 load_row_groups)
@@ -97,7 +98,7 @@ def make_reader(dataset_url,
                 cache_row_size_estimate=None, cache_extra_settings=None,
                 transform_spec=None, filters=None,
                 storage_options=None, zmq_copy_buffers=True,
-                profiling_enabled=False):
+                profiling_enabled=False, decode_hints=None):
     """Row-granular reader for petastorm_tpu datasets (codec-decoded rows).
 
     Mirrors the reference factory (``reader.py:61-195``). Raises a helpful error
@@ -130,7 +131,7 @@ def make_reader(dataset_url,
                   predicate=predicate, rowgroup_selector=rowgroup_selector,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec, filters=filters,
-                  pool=pool, is_batched_reader=False)
+                  pool=pool, is_batched_reader=False, decode_hints=decode_hints)
 
 
 def make_columnar_reader(dataset_url,
@@ -146,7 +147,7 @@ def make_columnar_reader(dataset_url,
                          cache_row_size_estimate=None, cache_extra_settings=None,
                          transform_spec=None, filters=None,
                          storage_options=None, zmq_copy_buffers=True,
-                         profiling_enabled=False):
+                         profiling_enabled=False, decode_hints=None):
     """Vectorized codec-decoded reader for petastorm_tpu datasets.
 
     Yields **batch namedtuples of decoded numpy column arrays** (one per row
@@ -187,7 +188,7 @@ def make_columnar_reader(dataset_url,
                   predicate=predicate, rowgroup_selector=rowgroup_selector,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec, filters=filters,
-                  pool=pool, is_batched_reader=True)
+                  pool=pool, is_batched_reader=True, decode_hints=decode_hints)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -239,7 +240,7 @@ class Reader:
                  shuffle_row_drop_partitions=1, predicate=None, rowgroup_selector=None,
                  num_epochs=1, cur_shard=None, shard_count=None,
                  cache=None, transform_spec=None, filters=None,
-                 pool=None, is_batched_reader=False):
+                 pool=None, is_batched_reader=False, decode_hints=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -327,7 +328,10 @@ class Reader:
             'local_cache': cache,
             'transform_spec': transform_spec,
             'transformed_schema': transformed_schema,
+            'decode_hints': decode_hints,
         }
+        # fail fast on bad hints (workers rebuild these after unpickling)
+        build_decode_overrides(stored_schema, decode_hints)
         pool.start(worker_class, worker_args, self._ventilator)
         self._results_reader = results_reader_factory(transformed_schema, self.ngram)
         self._stopped = False

@@ -40,7 +40,8 @@ class ColumnarResultsReader:
         return self._schema.make_batch_namedtuple(**columns)
 
 
-def _decode_binary_column(column: pa.ChunkedArray, field) -> np.ndarray:
+def _decode_binary_column(column: pa.ChunkedArray, field,
+                          decode_override=None) -> np.ndarray:
     """Decode a codec-encoded binary column into (n, *shape) (fixed shapes)
     or an object array (wildcard shapes, null cells, non-ndarray payloads)."""
     codec = field.codec
@@ -51,7 +52,8 @@ def _decode_binary_column(column: pa.ChunkedArray, field) -> np.ndarray:
         if fixed:
             return np.empty((0,) + tuple(field.shape), dtype=field.numpy_dtype)
         return np.empty(0, dtype=object)
-    decode = lambda cell: None if cell is None else codec.decode(field, cell)  # noqa: E731
+    cell_decode = decode_override or (lambda cell: codec.decode(field, cell))
+    decode = lambda cell: None if cell is None else cell_decode(cell)  # noqa: E731
     if fixed and column.null_count == 0:
         first = decode(raw[0])
         if isinstance(first, np.ndarray):
@@ -100,11 +102,12 @@ def _list_column_to_numpy(column: pa.ChunkedArray, field) -> np.ndarray:
     return out
 
 
-def _column_to_numpy(column: pa.ChunkedArray, field) -> np.ndarray:
+def _column_to_numpy(column: pa.ChunkedArray, field,
+                     decode_override=None) -> np.ndarray:
     """Decoded numpy column for any unischema field."""
     if field.codec is not None and (
             pa.types.is_binary(column.type) or pa.types.is_large_binary(column.type)):
-        return _decode_binary_column(column, field)
+        return _decode_binary_column(column, field, decode_override)
     if pa.types.is_list(column.type) or pa.types.is_large_list(column.type):
         return _list_column_to_numpy(column, field)
     if pa.types.is_string(column.type) or pa.types.is_large_string(column.type):
@@ -173,7 +176,8 @@ class ColumnarWorker(ParquetPieceWorker):
             if name not in table.column_names:
                 continue
             field = self._full_schema.fields[name]
-            out[name] = _column_to_numpy(table.column(name), field)
+            out[name] = _column_to_numpy(table.column(name), field,
+                                         self._decode_overrides.get(name))
         return out
 
     def _load(self, piece) -> Dict[str, np.ndarray]:

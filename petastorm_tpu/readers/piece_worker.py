@@ -24,6 +24,12 @@ class ParquetPieceWorker(WorkerBase):
         self._local_cache = args['local_cache']
         self._transform_spec = args['transform_spec']
         self._transformed_schema = args['transformed_schema']
+        from petastorm_tpu.codecs import build_decode_overrides
+        # built here (not in the factory) so only plain dicts cross the
+        # process-pool pickle boundary
+        self._decode_hints = args.get('decode_hints')
+        self._decode_overrides = build_decode_overrides(
+            self._full_schema, self._decode_hints)
         self._open_files: Dict[str, pq.ParquetFile] = {}
 
     def shutdown(self):
@@ -41,6 +47,15 @@ class ParquetPieceWorker(WorkerBase):
         return [n for n in names if n not in partition_keys]
 
     def _cache_key(self, prefix: str, piece) -> str:
-        return '{}:{}:{}:{}'.format(
+        # decode_hints change what a decoded row group contains (e.g. image
+        # resolution) — they must partition the cache, or a reader with
+        # different hints would be served wrong-resolution data
+        hints = ''
+        if self._decode_hints:
+            hints = ':' + hashlib.md5(
+                repr(sorted((k, sorted(v.items()))
+                            for k, v in self._decode_hints.items())).encode()
+            ).hexdigest()[:12]
+        return '{}:{}:{}:{}{}'.format(
             prefix, hashlib.md5(str(self._dataset_path).encode()).hexdigest(),
-            piece.path, piece.row_group)
+            piece.path, piece.row_group, hints)

@@ -87,7 +87,7 @@ class RowGroupWorker(ParquetPieceWorker):
                 field = schema.fields.get(key)
                 if field is not None:
                     raw[key] = _cast_partition_value(field, value)
-            decoded.append(decode_row(raw, schema))
+            decoded.append(decode_row(raw, schema, self._decode_overrides))
         return decoded
 
     def _load_rows(self, piece) -> List[dict]:

@@ -306,9 +306,13 @@ def encode_row(schema: Unischema, row_dict: Dict[str, Any]) -> Dict[str, Any]:
     return encoded
 
 
-def decode_row(row: Dict[str, Any], schema: Unischema) -> Dict[str, Any]:
+def decode_row(row: Dict[str, Any], schema: Unischema,
+               decode_overrides: Dict[str, Any] = None) -> Dict[str, Any]:
     """Decode one storage-form row dict using the schema's codecs
-    (reference ``petastorm/utils.py:52-85``)."""
+    (reference ``petastorm/utils.py:52-85``).
+
+    ``decode_overrides`` maps field name -> callable(value) replacing the
+    codec's plain ``decode`` (e.g. scaled image decode)."""
     decoded = {}
     for name, value in row.items():
         field = schema.fields.get(name)
@@ -316,6 +320,8 @@ def decode_row(row: Dict[str, Any], schema: Unischema) -> Dict[str, Any]:
             continue
         if value is None:
             decoded[name] = None
+        elif decode_overrides and name in decode_overrides:
+            decoded[name] = decode_overrides[name](value)
         elif field.codec is not None:
             decoded[name] = field.codec.decode(field, value)
         elif isinstance(field.numpy_dtype, np.dtype) and field.numpy_dtype.kind in 'biufc':

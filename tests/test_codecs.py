@@ -89,3 +89,98 @@ def test_json_registry_roundtrip():
 def test_unknown_codec_name_raises():
     with pytest.raises(ValueError, match='Unknown codec'):
         codec_from_json_dict({'codec': 'nope'})
+
+
+class TestFastNpyDecode:
+    """NdarrayCodec's ast-free fast path must agree with np.load exactly and
+    fall back for anything outside np.save's standard v1 form."""
+
+    @pytest.mark.parametrize('arr', [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.arange(5, dtype=np.int64),
+        np.float64(3.5) * np.ones(()),                    # 0-d
+        np.arange(24, dtype=np.uint8).reshape(2, 3, 4),
+        np.array([True, False]),
+        np.arange(6, dtype='>i4'),                        # big-endian
+        np.array(['a', 'bc'], dtype='<U2'),
+    ], ids=['f32_2d', 'i64_1d', 'f64_0d', 'u8_3d', 'bool', 'be_i4', 'unicode'])
+    def test_fast_path_matches_np_load(self, arr):
+        import io
+        from petastorm_tpu.codecs import _fast_npy_decode
+        buf = io.BytesIO()
+        np.save(buf, arr)
+        payload = buf.getvalue()
+        fast = _fast_npy_decode(payload)
+        assert fast is not None
+        ref = np.load(io.BytesIO(payload))
+        assert fast.dtype == ref.dtype and fast.shape == ref.shape
+        np.testing.assert_array_equal(fast, ref)
+
+    @pytest.mark.parametrize('arr', [
+        np.asfortranarray(np.arange(6, dtype=np.float32).reshape(2, 3)),
+        np.array([{'x': 1}], dtype=object),
+    ], ids=['fortran', 'object'])
+    def test_nonstandard_payloads_fall_back(self, arr):
+        import io
+        from petastorm_tpu.codecs import _fast_npy_decode
+        buf = io.BytesIO()
+        np.save(buf, arr)
+        assert _fast_npy_decode(buf.getvalue()) is None
+        # and the codec still decodes them through np.load
+        field = UnischemaField('x', arr.dtype, arr.shape, NdarrayCodec(), False)
+        if arr.dtype != object:   # object arrays are not encodable anyway
+            out = NdarrayCodec().decode(field, buf.getvalue())
+            np.testing.assert_array_equal(out, arr)
+
+    def test_roundtrip_through_codec_is_value_exact(self):
+        field = UnischemaField('m', np.float32, (3, 4), NdarrayCodec(), False)
+        value = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = NdarrayCodec().decode(field, NdarrayCodec().encode(field, value))
+        np.testing.assert_array_equal(out, value)
+
+
+class TestScaledImageDecode:
+    def _field(self, h, w, codec='jpeg'):
+        return UnischemaField('img', np.uint8, (h, w, 3),
+                              CompressedImageCodec(codec), False)
+
+    def _payload(self, field):
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 255, field.shape).astype(np.uint8)
+        return CompressedImageCodec(field.codec.image_codec).encode(field, img)
+
+    @pytest.mark.parametrize('min_shape,expected_hw', [
+        ((112, 112), (188, 250)),   # denom 2: 188x250 covers 112
+        ((60, 60), (94, 125)),      # denom 4
+        ((20, 20), (47, 63)),       # denom 8
+        ((224, 224), (376, 500)),   # denom 2 would be 188 < 224: full decode
+    ])
+    def test_denominator_selection(self, min_shape, expected_hw):
+        field = self._field(376, 500)
+        payload = self._payload(field)
+        out = field.codec.decode_scaled(field, payload, min_shape)
+        assert out.shape[:2] == expected_hw
+
+    def test_allow_upscale_takes_one_more_halving(self):
+        field = self._field(376, 500)
+        payload = self._payload(field)
+        out = field.codec.decode_scaled(field, payload, (224, 224),
+                                        allow_upscale=True)
+        assert out.shape[:2] == (188, 250)   # within one halving of 224
+
+    def test_wildcard_shape_falls_back_to_full(self):
+        field = UnischemaField('img', np.uint8, (None, None, 3),
+                               CompressedImageCodec('jpeg'), False)
+        src = self._field(376, 500)
+        out = field.codec.decode_scaled(field, self._payload(src), (10, 10))
+        assert out.shape[:2] == (376, 500)
+
+    def test_uint16_png_never_degrades(self):
+        # REDUCED flags force 8-bit: uint16 fields must take the full path
+        field = UnischemaField('img', np.uint16, (64, 64),
+                               CompressedImageCodec('png'), False)
+        value = (np.arange(64 * 64, dtype=np.uint16) * 7).reshape(64, 64)
+        payload = CompressedImageCodec('png').encode(field, value)
+        out = field.codec.decode_scaled(field, payload, (8, 8))
+        assert out.dtype == np.uint16 and out.shape == (64, 64)
+        np.testing.assert_array_equal(out, value)

@@ -200,3 +200,64 @@ class TestEpochCacheOnDevice:
     def test_empty_loader_terminates(self):
         from petastorm_tpu.jax_utils import epoch_cache_on_device
         assert list(epoch_cache_on_device([])) == []
+
+
+class TestDecodeHints:
+    @pytest.fixture(scope='class')
+    def image_url(self, tmp_path_factory):
+        from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        schema = Unischema('Img', [
+            UnischemaField('id', np.int64, (), ScalarCodec(), False),
+            UnischemaField('image', np.uint8, (376, 500, 3),
+                           CompressedImageCodec('jpeg'), False)])
+        url = 'file://' + str(tmp_path_factory.mktemp('hints') / 'ds')
+        rng = np.random.default_rng(0)
+        with materialize_dataset(url, schema, rows_per_file=8) as w:
+            w.write_rows({'id': np.int64(i),
+                          'image': rng.integers(0, 255, (376, 500, 3)).astype(np.uint8)}
+                         for i in range(16))
+        return url
+
+    def test_columnar_reader_scaled_decode(self, image_url):
+        from petastorm_tpu.reader import make_columnar_reader
+        with make_columnar_reader(image_url, shuffle_row_groups=False,
+                                  decode_hints={'image': {'min_shape': (112, 112)}}) as r:
+            batch = next(r)
+        assert batch.image.shape[1:] == (188, 250, 3)    # jpeg DCT denom 2
+
+    @pytest.mark.parametrize('pool', ['dummy', 'process'])
+    def test_row_reader_scaled_decode(self, image_url, pool):
+        from petastorm_tpu import make_reader
+        with make_reader(image_url, shuffle_row_groups=False,
+                         reader_pool_type=pool, workers_count=2,
+                         decode_hints={'image': {'min_shape': (40, 40)}}) as r:
+            row = next(r)
+        assert row.image.shape == (47, 63, 3)            # denom 8
+
+    def test_bad_hint_fails_at_construction(self, image_url):
+        from petastorm_tpu import make_reader
+        with pytest.raises(ValueError, match='unknown field'):
+            make_reader(image_url, decode_hints={'nope': {'min_shape': (8, 8)}})
+        with pytest.raises(ValueError, match='decode_scaled'):
+            make_reader(image_url, decode_hints={'id': {'min_shape': (8, 8)}})
+
+    def test_typoed_hint_kwarg_fails_at_construction(self, image_url):
+        from petastorm_tpu import make_reader
+        with pytest.raises(ValueError, match='decode_scaled'):
+            make_reader(image_url, decode_hints={'image': {'min_shap': (8, 8)}})
+
+    def test_hints_partition_the_disk_cache(self, image_url, tmp_path):
+        """Two readers sharing one cache dir but using different decode hints
+        must not serve each other's decoded row groups."""
+        from petastorm_tpu import make_reader
+        kwargs = dict(shuffle_row_groups=False, reader_pool_type='dummy',
+                      cache_type='local-disk', cache_location=str(tmp_path),
+                      cache_size_limit=1 << 30)
+        with make_reader(image_url,
+                         decode_hints={'image': {'min_shape': (40, 40)}},
+                         **kwargs) as r:
+            assert next(r).image.shape == (47, 63, 3)
+        with make_reader(image_url, **kwargs) as r:      # no hints
+            assert next(r).image.shape == (376, 500, 3)  # not the cached 1/8
