@@ -279,6 +279,18 @@ class CompressedImageCodec(DataframeColumnCodec):
     def decode(self, unischema_field, value):
         return self._decode_flag(unischema_field, value, None)
 
+    def validate_decode_hint(self, unischema_field, min_shape=None,
+                             allow_upscale=False):
+        """Construction-time value check for :meth:`decode_scaled` kwargs —
+        bad hint VALUES must fail at the factory, not per-cell in workers."""
+        if min_shape is not None:
+            try:
+                int(min_shape[0]), int(min_shape[1])
+            except (TypeError, IndexError, KeyError, ValueError):
+                raise ValueError(
+                    'min_shape must be a (height, width) pair, got {!r}'
+                    .format(min_shape))
+
     def decode_scaled(self, unischema_field, value, min_shape,
                       allow_upscale=False):
         """Decode at reduced resolution when the consumer will downscale
@@ -291,11 +303,14 @@ class CompressedImageCodec(DataframeColumnCodec):
         torchvision's ``decode_jpeg(..., size=...)``."""
         import cv2
         shape = unischema_field.shape
-        # REDUCED_* flags force 8-bit 3-channel (or 8-bit gray): anything the
-        # reduced decode cannot represent faithfully — uint16 png, RGBA —
-        # must take the full-resolution path rather than silently degrade
+        # jpeg only: the DCT scaling is where the decode savings are, and
+        # cv2's REDUCED_* output size for jpeg is ceil(dim/denom) — png
+        # ROUNDS instead (verified: 65/8 png -> 8, jpeg -> 9), which would
+        # under-deliver min_shape. REDUCED_* also forces 8-bit 3-channel
+        # (or 8-bit gray): uint16/RGBA must not silently degrade.
         representable = (
-            np.dtype(unischema_field.numpy_dtype) == np.uint8
+            self._image_codec in ('.jpg', '.jpeg')
+            and np.dtype(unischema_field.numpy_dtype) == np.uint8
             and (shape is None or len(shape) == 2
                  or (len(shape) == 3 and shape[2] == 3)))
         if (min_shape is None or not representable or shape is None
@@ -426,6 +441,9 @@ def build_decode_overrides(schema, decode_hints):
             raise ValueError(
                 'decode_hints for field {!r} do not match {}.decode_scaled: {}'
                 .format(name, type(field.codec).__name__, e))
+        validate = getattr(field.codec, 'validate_decode_hint', None)
+        if validate is not None:  # value-level check (types/arity of kwargs)
+            validate(field, **hint)
         def _decode(value, _fn=scaled, _field=field, _kw=dict(hint)):
             return _fn(_field, value, **_kw)
         overrides[name] = _decode

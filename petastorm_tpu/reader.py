@@ -77,6 +77,24 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer,
                      'got {!r}'.format(reader_pool_type))
 
 
+def _relax_hinted_shapes(schema, decode_hints, stored_schema):
+    """Copy of ``schema`` with the spatial dims of hinted fields made dynamic
+    (``None`` wildcards) — scaled decode changes them at read time. A field
+    whose shape a TransformSpec redeclared (differs from the stored shape,
+    e.g. a resize to a fixed size) keeps its declared shape."""
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    fields = []
+    for f in schema.fields.values():
+        stored = stored_schema.fields.get(f.name)
+        if (f.name in decode_hints and f.shape and len(f.shape) >= 2
+                and stored is not None and f.shape == stored.shape):
+            f = UnischemaField(f.name, f.numpy_dtype,
+                               (None, None) + tuple(f.shape[2:]),
+                               f.codec, f.nullable)
+        fields.append(f)
+    return Unischema(schema._name, fields)
+
+
 def _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process):
     if not shard_by_jax_process:
         return cur_shard, shard_count
@@ -288,6 +306,16 @@ class Reader:
 
         transformed_schema = (transform_schema(view_schema, transform_spec)
                               if transform_spec is not None else view_schema)
+        if decode_hints:
+            # hinted fields decode at reduced resolution: the consumer-facing
+            # schema must advertise dynamic spatial dims, or adapters (TF
+            # static shapes, columnar assembly asserts) would promise the
+            # full-resolution shape the data no longer has. Workers keep the
+            # original schema — decode_scaled needs the stored shape to pick
+            # its denominator.
+            transformed_schema = _relax_hinted_shapes(transformed_schema,
+                                                      decode_hints,
+                                                      stored_schema)
         #: The schema of the rows/batches this reader yields.
         self.schema = transformed_schema
 

@@ -1,11 +1,11 @@
 """TensorFlow adapter (reference parity: ``petastorm/tf_utils.py``).
 
 Provides ``make_petastorm_dataset(reader)`` → ``tf.data.Dataset`` via
-``from_generator`` with static-shape fixup, plus the dtype/value sanitization
-table (uint16→int32, uint32→int64, Decimal→string, datetime64→int64 ns).
-The legacy graph-mode ``tf_tensors`` queue API is intentionally not ported:
-``tf.data`` is the supported ingestion path in TF2 (reference itself routes
-``make_petastorm_dataset`` this way, ``tf_utils.py:329-399``).
+``from_generator`` with static-shape fixup, the dtype/value sanitization
+table (uint16→int32, uint32→int64, Decimal→string, datetime64→int64 ns), and
+the graph-mode ``tf_tensors`` API (py_func + optional RandomShuffleQueue,
+reference ``tf_utils.py:270-327``) for TF1-compat session code — new code
+should prefer ``tf.data``.
 
 TensorFlow is imported lazily so the rest of the framework never pays for it.
 """
@@ -98,17 +98,8 @@ def make_petastorm_dataset(reader):
     batched = reader.batched_output
 
     def set_shape_and_name(*row):
-        out = []
-        for value, field in zip(row, fields):
-            shape = tuple(field.shape or ())
-            static = tuple(s if s is not None else None for s in shape)
-            if batched:
-                static = (None,) + static
-            try:
-                value.set_shape(static)
-            except ValueError:
-                pass  # ragged/opaque: leave dynamic
-            out.append(value)
+        out = [_set_static_shape(value, field, batched)
+               for value, field in zip(row, fields)]
         # namedtuple row type with tensor values (same type the raw reader
         # yields for decoded rows)
         return schema.make_batch_namedtuple(**dict(zip(names, out)))
@@ -151,3 +142,122 @@ def _make_ngram_dataset(reader):
         return result
 
     return dataset.map(unflatten)
+
+
+def _set_static_shape(tensor, field, batched):
+    shape = tuple(field.shape or ())
+    static = tuple(s if s is not None else None for s in shape)
+    if batched:
+        static = (None,) + static
+    try:
+        tensor.set_shape(static)
+    except ValueError:
+        pass  # ragged/opaque: leave dynamic
+    return tensor
+
+
+def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
+    """Graph-mode tensors: each ``session.run`` pulls the next row (or
+    row-group batch) from the reader (reference ``tf_utils.py:270-327``; queue
+    variant ``:202-252``).
+
+    TF1-compat API for legacy graph/session code — build under
+    ``tf.compat.v1.Graph`` and evaluate with a ``tf.compat.v1.Session``.
+    Reader exhaustion surfaces as ``tf.errors.OutOfRangeError``, the standard
+    end-of-input signal graph training loops already handle. With
+    ``shuffling_queue_capacity > 0`` rows pass through a
+    ``RandomShuffleQueue`` (start it with
+    ``tf.compat.v1.train.start_queue_runners``); the queue is refused for
+    batched readers exactly as the reference refuses it
+    (``tf_utils.py:308-312``). New TF2 code should prefer
+    :func:`make_petastorm_dataset`.
+    """
+    tf = _tf()
+    v1 = tf.compat.v1
+    schema = reader.schema
+    batched = bool(getattr(reader, 'batched_output', False))
+    if batched and shuffling_queue_capacity > 0:
+        raise ValueError('shuffling_queue_capacity is not supported with '
+                         'batched readers (reference tf_utils.py:308-312); '
+                         'shuffle in the reader instead')
+    ngram = getattr(reader, 'ngram', None)
+    if ngram is not None:
+        return _tf_tensors_ngram(reader, shuffling_queue_capacity,
+                                 min_after_dequeue)
+
+    fields = list(schema.fields.values())
+    names = [f.name for f in fields]
+    dtypes = [_field_tf_dtype(f) for f in fields]
+
+    def next_row():
+        # StopIteration propagates: py_func surfaces it to session.run as
+        # tf.errors.OutOfRangeError, the standard end-of-input signal
+        item = next(reader)
+        row = item._asdict() if hasattr(item, '_asdict') else dict(item)
+        sane = _sanitize_row(row)
+        return [np.asarray(sane[n]) for n in names]
+
+    tensors = v1.py_func(next_row, [], dtypes, name='petastorm_tpu_row')
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]       # single-dtype py_func returns a bare tensor
+    if shuffling_queue_capacity > 0:
+        queue = tf.queue.RandomShuffleQueue(
+            shuffling_queue_capacity, min_after_dequeue, dtypes,
+            name='petastorm_tpu_shuffling_queue')
+        runner = v1.train.QueueRunner(queue, [queue.enqueue(tensors)])
+        v1.train.add_queue_runner(runner)
+        # named size op so training loops can monitor fill level (reference
+        # exposes the same, tf_utils.py:46-48,208-210)
+        v1.identity(tf.cast(queue.size(), tf.int32),
+                    name='random_shuffling_queue_size')
+        tensors = queue.dequeue()
+        if not isinstance(tensors, (list, tuple)):
+            tensors = [tensors]   # single-component dequeue, same deal
+    out = [_set_static_shape(t, f, batched) for t, f in zip(tensors, fields)]
+    make = schema.make_batch_namedtuple if batched else schema.make_namedtuple
+    return make(**dict(zip(names, out)))
+
+
+def _tf_tensors_ngram(reader, shuffling_queue_capacity, min_after_dequeue):
+    """NGram variant: windows flattened across the py_func boundary and
+    rebuilt as {offset: namedtuple} of tensors (reference
+    ``tf_utils.py:255-267,402-433``)."""
+    tf = _tf()
+    v1 = tf.compat.v1
+    ngram = reader.ngram
+    timesteps = sorted(ngram.fields.keys())
+    flat_fields = []
+    for ts in timesteps:
+        schema_at_ts = ngram.get_schema_at_timestep(reader.schema, ts)
+        for f in schema_at_ts.fields.values():
+            flat_fields.append((ts, f))
+    dtypes = [_field_tf_dtype(f) for _, f in flat_fields]
+
+    def next_window():
+        item = next(reader)   # StopIteration -> OutOfRangeError via py_func
+        return [np.asarray(_sanitize_field_tf_types(getattr(item[ts], f.name)))
+                for ts, f in flat_fields]
+
+    tensors = v1.py_func(next_window, [], dtypes, name='petastorm_tpu_ngram')
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if shuffling_queue_capacity > 0:
+        queue = tf.queue.RandomShuffleQueue(
+            shuffling_queue_capacity, min_after_dequeue, dtypes,
+            name='petastorm_tpu_shuffling_queue')
+        v1.train.add_queue_runner(v1.train.QueueRunner(queue,
+                                                       [queue.enqueue(tensors)]))
+        tensors = queue.dequeue()
+        if not isinstance(tensors, (list, tuple)):
+            tensors = [tensors]
+    result = {}
+    idx = 0
+    for ts in timesteps:
+        view = ngram.get_schema_at_timestep(reader.schema, ts)
+        names = list(view.fields.keys())
+        step = [_set_static_shape(t, f, False)
+                for t, f in zip(tensors[idx:idx + len(names)],
+                                view.fields.values())]
+        result[ts] = view.make_namedtuple(**dict(zip(names, step)))
+        idx += len(names)
+    return result

@@ -184,3 +184,23 @@ class TestScaledImageDecode:
         out = field.codec.decode_scaled(field, payload, (8, 8))
         assert out.dtype == np.uint16 and out.shape == (64, 64)
         np.testing.assert_array_equal(out, value)
+
+    def test_png_never_scales(self):
+        # cv2's REDUCED_* rounds (not ceils) for png, which could deliver an
+        # image SMALLER than min_shape — png always takes the full path
+        field = UnischemaField('img', np.uint8, (65, 65),
+                               CompressedImageCodec('png'), False)
+        value = np.arange(65 * 65, dtype=np.uint8).reshape(65, 65) % 251
+        payload = CompressedImageCodec('png').encode(field, value)
+        out = field.codec.decode_scaled(field, payload, (9, 9))
+        assert out.shape == (65, 65)
+        np.testing.assert_array_equal(out, value)
+
+    def test_bad_min_shape_value_rejected(self):
+        from petastorm_tpu.codecs import build_decode_overrides
+        from petastorm_tpu.unischema import Unischema
+        field = UnischemaField('img', np.uint8, (64, 64, 3),
+                               CompressedImageCodec('jpeg'), False)
+        schema = Unischema('S', [field])
+        with pytest.raises(ValueError, match='min_shape'):
+            build_decode_overrides(schema, {'img': {'min_shape': 112}})

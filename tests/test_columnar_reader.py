@@ -261,3 +261,11 @@ class TestDecodeHints:
             assert next(r).image.shape == (47, 63, 3)
         with make_reader(image_url, **kwargs) as r:      # no hints
             assert next(r).image.shape == (376, 500, 3)  # not the cached 1/8
+
+    def test_hinted_reader_schema_relaxes_spatial_dims(self, image_url):
+        from petastorm_tpu import make_reader
+        with make_reader(image_url,
+                         decode_hints={'image': {'min_shape': (40, 40)}}) as r:
+            assert r.schema.fields['image'].shape == (None, None, 3)
+        with make_reader(image_url) as r:      # no hints: full static shape
+            assert r.schema.fields['image'].shape == (376, 500, 3)

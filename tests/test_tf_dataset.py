@@ -75,3 +75,104 @@ class TestNgramDataset:
         for w in windows:
             assert set(w.keys()) == {0, 1}
             assert int(w[1]['id'].numpy()) == int(w[0]['id'].numpy()) + 1
+
+
+class TestTfTensorsGraphMode:
+    """Graph-mode tf_tensors parity (reference test_tf_utils.py)."""
+
+    def test_rows_through_session(self, synthetic_dataset):
+        import tensorflow as tf
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.tf_utils import tf_tensors
+        with make_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                         shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            graph = tf.compat.v1.Graph()
+            with graph.as_default():
+                row = tf_tensors(reader)
+                assert row.matrix.shape.as_list() == [8, 4, 3]
+                with tf.compat.v1.Session() as sess:
+                    seen = set()
+                    try:
+                        while True:
+                            out = sess.run(row)
+                            seen.add(int(out.id))
+                    except tf.errors.OutOfRangeError:
+                        pass
+        assert seen == {r['id'] for r in synthetic_dataset.data}
+
+    def test_value_exact_against_generator(self, synthetic_dataset):
+        import tensorflow as tf
+        import numpy as np
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.tf_utils import tf_tensors
+        expected = {r['id']: r['matrix'] for r in synthetic_dataset.data}
+        with make_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                         shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            graph = tf.compat.v1.Graph()
+            with graph.as_default():
+                row = tf_tensors(reader)
+                with tf.compat.v1.Session() as sess:
+                    out = sess.run(row)
+        np.testing.assert_array_equal(out.matrix, expected[int(out.id)])
+
+    def test_shuffling_queue(self, synthetic_dataset):
+        import tensorflow as tf
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.tf_utils import tf_tensors
+        with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         shuffle_row_groups=False, num_epochs=None,
+                         reader_pool_type='dummy') as reader:
+            graph = tf.compat.v1.Graph()
+            with graph.as_default():
+                row = tf_tensors(reader, shuffling_queue_capacity=30,
+                                 min_after_dequeue=10)
+                with tf.compat.v1.Session() as sess:
+                    coord = tf.compat.v1.train.Coordinator()
+                    threads = tf.compat.v1.train.start_queue_runners(
+                        sess=sess, coord=coord)
+                    ids = [int(sess.run(row).id) for _ in range(40)]
+                    coord.request_stop()
+                    coord.join(threads, stop_grace_period_secs=5,
+                               ignore_live_threads=True)
+        assert len(ids) == 40
+        assert ids != sorted(ids)      # the queue decorrelated the stream
+
+    def test_batched_reader_refuses_queue(self, scalar_dataset):
+        from petastorm_tpu import make_batch_reader
+        from petastorm_tpu.tf_utils import tf_tensors
+        with make_batch_reader(scalar_dataset.url,
+                               reader_pool_type='dummy') as reader:
+            with pytest.raises(ValueError, match='shuffling_queue_capacity'):
+                tf_tensors(reader, shuffling_queue_capacity=10)
+
+    def test_ngram_windows_through_session(self, tmp_path):
+        import numpy as np
+        import tensorflow as tf
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+        from petastorm_tpu.ngram import NGram
+        from petastorm_tpu.tf_utils import tf_tensors
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        schema = Unischema('Seq', [
+            UnischemaField('ts', np.int64, (), ScalarCodec(), False),
+            UnischemaField('v', np.float32, (2,), NdarrayCodec(), False)])
+        url = 'file://' + str(tmp_path / 'seq')
+        with materialize_dataset(url, schema, rows_per_file=100) as w:
+            w.write_rows({'ts': np.int64(t), 'v': np.full(2, t, np.float32)}
+                         for t in range(10))
+        ngram = NGram({0: ['ts', 'v'], 1: ['ts', 'v']}, delta_threshold=1,
+                      timestamp_field='ts')
+        with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            graph = tf.compat.v1.Graph()
+            with graph.as_default():
+                window = tf_tensors(reader)
+                assert set(window.keys()) == {0, 1}
+                with tf.compat.v1.Session() as sess:
+                    out = sess.run(window)
+        assert int(out[1].ts) == int(out[0].ts) + 1
+        np.testing.assert_array_equal(out[0].v,
+                                      np.full(2, int(out[0].ts), np.float32))
