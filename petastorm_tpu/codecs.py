@@ -284,12 +284,25 @@ class CompressedImageCodec(DataframeColumnCodec):
         """Construction-time value check for :meth:`decode_scaled` kwargs —
         bad hint VALUES must fail at the factory, not per-cell in workers."""
         if min_shape is not None:
-            try:
-                int(min_shape[0]), int(min_shape[1])
-            except (TypeError, IndexError, KeyError, ValueError):
+            ok = (isinstance(min_shape, (tuple, list))
+                  and len(min_shape) == 2
+                  and all(isinstance(s, (int, np.integer)) and s > 0
+                          for s in min_shape))
+            if not ok:
                 raise ValueError(
-                    'min_shape must be a (height, width) pair, got {!r}'
-                    .format(min_shape))
+                    'min_shape must be a (height, width) pair of positive '
+                    'ints, got {!r}'.format(min_shape))
+
+    def can_scale(self, unischema_field) -> bool:
+        """Whether :meth:`decode_scaled` can ever reduce this field: jpeg
+        only (png REDUCED rounds instead of ceiling), uint8 only, gray or
+        3-channel, with known spatial dims."""
+        shape = unischema_field.shape
+        return (self._image_codec in ('.jpg', '.jpeg')
+                and np.dtype(unischema_field.numpy_dtype) == np.uint8
+                and shape is not None and len(shape) >= 2
+                and all(s is not None for s in shape[:2])
+                and (len(shape) == 2 or (len(shape) == 3 and shape[2] == 3)))
 
     def decode_scaled(self, unischema_field, value, min_shape,
                       allow_upscale=False):
@@ -303,18 +316,7 @@ class CompressedImageCodec(DataframeColumnCodec):
         torchvision's ``decode_jpeg(..., size=...)``."""
         import cv2
         shape = unischema_field.shape
-        # jpeg only: the DCT scaling is where the decode savings are, and
-        # cv2's REDUCED_* output size for jpeg is ceil(dim/denom) — png
-        # ROUNDS instead (verified: 65/8 png -> 8, jpeg -> 9), which would
-        # under-deliver min_shape. REDUCED_* also forces 8-bit 3-channel
-        # (or 8-bit gray): uint16/RGBA must not silently degrade.
-        representable = (
-            self._image_codec in ('.jpg', '.jpeg')
-            and np.dtype(unischema_field.numpy_dtype) == np.uint8
-            and (shape is None or len(shape) == 2
-                 or (len(shape) == 3 and shape[2] == 3)))
-        if (min_shape is None or not representable or shape is None
-                or len(shape) < 2 or any(s is None for s in shape[:2])):
+        if min_shape is None or not self.can_scale(unischema_field):
             return self.decode(unischema_field, value)
         min_h, min_w = int(min_shape[0]), int(min_shape[1])
         color = len(shape) > 2

@@ -46,6 +46,8 @@ class TransformerConfig:
     # tokens routed past an expert's capacity are dropped (their residual
     # stream passes through unchanged, Switch-Transformer semantics).
     moe_capacity_factor: float = 1.25
+    # Weight of the Switch load-balancing auxiliary loss; 0 disables it.
+    moe_aux_weight: float = 0.01
     dtype: Any = jnp.bfloat16
     # 'ring' shards attention over the 'seq' mesh axis; 'flash'/'blockwise'
     # compute full attention locally (XLA all-gathers kv if seq is sharded).
@@ -287,35 +289,54 @@ def _moe_ffn(x, layer, config: TransformerConfig, mesh=None):
     flat = jnp.concatenate([out.reshape(e * capacity, d),
                             jnp.zeros((1, d), x.dtype)])     # overflow row
     y = jnp.zeros((n, d), x.dtype).at[order].set(flat[dest])
-    return (y * scale).reshape(b, l, d)
+
+    # Switch load-balancing aux loss: E * sum_e(token_fraction_e * mean
+    # router prob_e) — minimized (=1) at a uniform routing distribution.
+    # Differentiable through `probs`, so the router learns to balance.
+    frac = jnp.mean(jax.nn.one_hot(top, e, dtype=jnp.float32), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+    return (y * scale).reshape(b, l, d), aux
 
 
 def forward(params, tokens, config: TransformerConfig,
-            positions: Optional[jnp.ndarray] = None, mesh=None):
-    """tokens (B, L) int32 → logits (B, L, vocab) float32."""
+            positions: Optional[jnp.ndarray] = None, mesh=None,
+            return_aux: bool = False):
+    """tokens (B, L) int32 → logits (B, L, vocab) float32.
+
+    With ``return_aux=True`` also returns the summed MoE load-balancing
+    auxiliary loss (0.0 for dense models)."""
     c = config
     if positions is None:
         positions = jnp.arange(tokens.shape[1])
     x = params['embed'].astype(c.dtype)[tokens]              # (B, L, D)
+    aux_total = jnp.zeros((), jnp.float32)
     for layer in params['layers']:
         h = _rms_norm(x, layer['ln1'])
         x = x + _attention(h, layer, c, positions, mesh)
         h = _rms_norm(x, layer['ln2'])
         if c.n_experts > 0:
-            x = x + _moe_ffn(h, layer, c, mesh)
+            ffn_out, aux = _moe_ffn(h, layer, c, mesh)
+            x = x + ffn_out
+            aux_total = aux_total + aux
         else:
             x = x + _dense_ffn(h, layer)
     x = _rms_norm(x, params['final_norm'])
-    return (x @ params['unembed'].astype(c.dtype)).astype(jnp.float32)
+    logits = (x @ params['unembed'].astype(c.dtype)).astype(jnp.float32)
+    return (logits, aux_total) if return_aux else logits
 
 
 def loss_fn(params, tokens, targets, config: TransformerConfig, mesh=None):
-    """Next-token cross entropy; ``targets`` are tokens shifted by the caller
-    (the NGram pipeline emits aligned (input, target) windows)."""
-    logits = forward(params, tokens, config, mesh=mesh)
+    """Next-token cross entropy (+ weighted MoE load-balance aux for expert
+    models); ``targets`` are tokens shifted by the caller (the NGram pipeline
+    emits aligned (input, target) windows)."""
+    logits, aux = forward(params, tokens, config, mesh=mesh, return_aux=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    return jnp.mean(nll)
+    loss = jnp.mean(nll)
+    if config.n_experts > 0 and config.moe_aux_weight:
+        loss = loss + config.moe_aux_weight * aux
+    return loss
 
 
 # ---------------------------------------------------------------------------

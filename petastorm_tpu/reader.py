@@ -86,8 +86,14 @@ def _relax_hinted_shapes(schema, decode_hints, stored_schema):
     fields = []
     for f in schema.fields.values():
         stored = stored_schema.fields.get(f.name)
-        if (f.name in decode_hints and f.shape and len(f.shape) >= 2
-                and stored is not None and f.shape == stored.shape):
+        # only fields the codec can actually scale get dynamic dims — a
+        # hinted field decode_scaled always passes through (png, uint16,
+        # RGBA) keeps its exact static shape
+        scalable = (stored is not None
+                    and getattr(stored.codec, 'can_scale',
+                                lambda _f: False)(stored))
+        if (f.name in decode_hints and scalable and f.shape
+                and len(f.shape) >= 2 and f.shape == stored.shape):
             f = UnischemaField(f.name, f.numpy_dtype,
                                (None, None) + tuple(f.shape[2:]),
                                f.codec, f.nullable)

@@ -156,6 +156,28 @@ def _set_static_shape(tensor, field, batched):
     return tensor
 
 
+def _maybe_shuffle_queue(tensors, dtypes, capacity, min_after_dequeue):
+    """Normalize py_func output to a list and optionally route it through a
+    RandomShuffleQueue, exposing the named ``random_shuffling_queue_size``
+    monitoring op (reference ``tf_utils.py:46-48,208-210``)."""
+    tf = _tf()
+    v1 = tf.compat.v1
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]       # single-dtype py_func returns a bare tensor
+    if capacity > 0:
+        queue = tf.queue.RandomShuffleQueue(
+            capacity, min_after_dequeue, dtypes,
+            name='petastorm_tpu_shuffling_queue')
+        v1.train.add_queue_runner(
+            v1.train.QueueRunner(queue, [queue.enqueue(tensors)]))
+        v1.identity(tf.cast(queue.size(), tf.int32),
+                    name='random_shuffling_queue_size')
+        tensors = queue.dequeue()
+        if not isinstance(tensors, (list, tuple)):
+            tensors = [tensors]   # single-component dequeue, same deal
+    return tensors
+
+
 def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
     """Graph-mode tensors: each ``session.run`` pulls the next row (or
     row-group batch) from the reader (reference ``tf_utils.py:270-327``; queue
@@ -198,21 +220,8 @@ def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
         return [np.asarray(sane[n]) for n in names]
 
     tensors = v1.py_func(next_row, [], dtypes, name='petastorm_tpu_row')
-    if not isinstance(tensors, (list, tuple)):
-        tensors = [tensors]       # single-dtype py_func returns a bare tensor
-    if shuffling_queue_capacity > 0:
-        queue = tf.queue.RandomShuffleQueue(
-            shuffling_queue_capacity, min_after_dequeue, dtypes,
-            name='petastorm_tpu_shuffling_queue')
-        runner = v1.train.QueueRunner(queue, [queue.enqueue(tensors)])
-        v1.train.add_queue_runner(runner)
-        # named size op so training loops can monitor fill level (reference
-        # exposes the same, tf_utils.py:46-48,208-210)
-        v1.identity(tf.cast(queue.size(), tf.int32),
-                    name='random_shuffling_queue_size')
-        tensors = queue.dequeue()
-        if not isinstance(tensors, (list, tuple)):
-            tensors = [tensors]   # single-component dequeue, same deal
+    tensors = _maybe_shuffle_queue(tensors, dtypes, shuffling_queue_capacity,
+                                   min_after_dequeue)
     out = [_set_static_shape(t, f, batched) for t, f in zip(tensors, fields)]
     make = schema.make_batch_namedtuple if batched else schema.make_namedtuple
     return make(**dict(zip(names, out)))
@@ -239,17 +248,8 @@ def _tf_tensors_ngram(reader, shuffling_queue_capacity, min_after_dequeue):
                 for ts, f in flat_fields]
 
     tensors = v1.py_func(next_window, [], dtypes, name='petastorm_tpu_ngram')
-    if not isinstance(tensors, (list, tuple)):
-        tensors = [tensors]
-    if shuffling_queue_capacity > 0:
-        queue = tf.queue.RandomShuffleQueue(
-            shuffling_queue_capacity, min_after_dequeue, dtypes,
-            name='petastorm_tpu_shuffling_queue')
-        v1.train.add_queue_runner(v1.train.QueueRunner(queue,
-                                                       [queue.enqueue(tensors)]))
-        tensors = queue.dequeue()
-        if not isinstance(tensors, (list, tuple)):
-            tensors = [tensors]
+    tensors = _maybe_shuffle_queue(tensors, dtypes, shuffling_queue_capacity,
+                                   min_after_dequeue)
     result = {}
     idx = 0
     for ts in timesteps:

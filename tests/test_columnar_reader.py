@@ -269,3 +269,25 @@ class TestDecodeHints:
             assert r.schema.fields['image'].shape == (None, None, 3)
         with make_reader(image_url) as r:      # no hints: full static shape
             assert r.schema.fields['image'].shape == (376, 500, 3)
+
+    def test_unscalable_field_keeps_static_shape(self, tmp_path):
+        # png can never scale (REDUCED rounds), so a hint on it must not
+        # relax the advertised static shape either
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        schema = Unischema('Png', [
+            UnischemaField('id', np.int64, (), ScalarCodec(), False),
+            UnischemaField('image', np.uint8, (32, 32, 3),
+                           CompressedImageCodec('png'), False)])
+        url = 'file://' + str(tmp_path / 'png_ds')
+        rng = np.random.default_rng(0)
+        with materialize_dataset(url, schema) as w:
+            w.write_rows({'id': np.int64(i),
+                          'image': rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)}
+                         for i in range(4))
+        with make_reader(url, shuffle_row_groups=False,
+                         decode_hints={'image': {'min_shape': (8, 8)}}) as r:
+            assert r.schema.fields['image'].shape == (32, 32, 3)
+            assert next(r).image.shape == (32, 32, 3)

@@ -180,10 +180,12 @@ class TestMoeDispatch:
         cfg = _tiny_config(n_experts=4, moe_capacity_factor=4.0)
         layer, x = self._layer_and_x(cfg)
         with jax.default_device(cpus[0]):
-            sparse = tlm._moe_ffn(x, layer, cfg)
+            sparse, aux = tlm._moe_ffn(x, layer, cfg)
             dense = tlm._moe_ffn_dense(x, layer, cfg)
         np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense),
                                    atol=1e-5)
+        # Switch aux loss is minimized at 1.0 for perfectly uniform routing
+        assert float(aux) >= 1.0 - 1e-5
 
     def test_over_capacity_tokens_pass_through_as_zeros(self, cpus):
         from petastorm_tpu.models import transformer_lm as tlm
@@ -191,7 +193,7 @@ class TestMoeDispatch:
         cfg = _tiny_config(n_experts=2, moe_capacity_factor=2 * 1.0 / 32)
         layer, x = self._layer_and_x(cfg)
         with jax.default_device(cpus[0]):
-            out = np.asarray(tlm._moe_ffn(x, layer, cfg))
+            out = np.asarray(tlm._moe_ffn(x, layer, cfg)[0])
         flat = out.reshape(-1, cfg.d_model)
         zero_rows = np.all(flat == 0.0, axis=1).sum()
         assert zero_rows >= flat.shape[0] - 2    # ≤1 kept per expert
@@ -204,7 +206,7 @@ class TestMoeDispatch:
         def moe_flops(n_experts):
             cfg = _tiny_config(n_experts=n_experts, moe_capacity_factor=1.0)
             layer, x = self._layer_and_x(cfg)
-            fn = jax.jit(lambda x: tlm._moe_ffn(x, layer, cfg))
+            fn = jax.jit(lambda x: tlm._moe_ffn(x, layer, cfg)[0])
             return fn.lower(x).compile().cost_analysis()['flops']
 
         f2, f8 = moe_flops(2), moe_flops(8)
