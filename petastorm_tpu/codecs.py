@@ -284,10 +284,12 @@ class CompressedImageCodec(DataframeColumnCodec):
         """Construction-time value check for :meth:`decode_scaled` kwargs —
         bad hint VALUES must fail at the factory, not per-cell in workers."""
         if min_shape is not None:
-            ok = (isinstance(min_shape, (tuple, list))
-                  and len(min_shape) == 2
-                  and all(isinstance(s, (int, np.integer)) and s > 0
-                          for s in min_shape))
+            import operator
+            try:        # any 2-sequence of integral values (tuple/list/ndarray)
+                vals = [operator.index(s) for s in min_shape]
+                ok = len(vals) == 2 and all(v > 0 for v in vals)
+            except TypeError:
+                ok = False
             if not ok:
                 raise ValueError(
                     'min_shape must be a (height, width) pair of positive '

@@ -60,6 +60,99 @@ def _is_device_compatible(arr):
     return getattr(arr, 'dtype', np.dtype(object)).kind not in _DEVICE_INCOMPATIBLE_KINDS
 
 
+def validate_pad_spec(pad_spec):
+    """Normalize/validate a ragged-padding spec at loader construction.
+
+    ``pad_spec`` maps field name -> ``{'buckets': [n1, n2, ...]}`` or
+    ``{'max_len': n}``, plus optional ``'pad_value'`` (default 0) and
+    ``'length_field'`` (default ``'<name>_len'``)."""
+    if not pad_spec:
+        return None
+    normalized = {}
+    for name, spec in pad_spec.items():
+        spec = dict(spec)
+        buckets = spec.pop('buckets', None)
+        max_len = spec.pop('max_len', None)
+        pad_value = spec.pop('pad_value', 0)
+        length_field = spec.pop('length_field', name + '_len')
+        if spec:
+            raise ValueError('pad_spec for {!r} has unknown keys {}'.format(
+                name, sorted(spec)))
+        if (buckets is None) == (max_len is None):
+            raise ValueError("pad_spec for {!r} needs exactly one of "
+                             "'buckets' or 'max_len'".format(name))
+        if buckets is None:
+            buckets = [max_len]
+        buckets = sorted(int(b) for b in buckets)
+        if not buckets or buckets[0] <= 0:
+            raise ValueError('pad_spec buckets for {!r} must be positive '
+                             'ints, got {!r}'.format(name, buckets))
+        normalized[name] = {'buckets': buckets, 'pad_value': pad_value,
+                            'length_field': length_field}
+    return normalized
+
+
+def pad_ragged_batch(batch, pad_spec):
+    """Pad ragged (object-dtype) columns into dense bucketed arrays so
+    variable-length fields can live in HBM under jit.
+
+    For each spec'd field, rows are padded along their first dimension to the
+    smallest bucket covering the batch's longest row, and the true lengths are
+    emitted as an int32 ``length_field`` column (build masks from it on
+    device). Bucketing bounds XLA recompilation to ``len(buckets)`` shapes —
+    the pad-to-bucket answer to the static-shape-vs-ragged-fields problem
+    (SURVEY §7 "hard parts"). Already-dense columns pass through with a
+    constant length column for API uniformity."""
+    out = dict(batch)
+    for name, spec in pad_spec.items():
+        col = out.get(name)
+        if col is None:
+            continue
+        if not (isinstance(col, np.ndarray) and col.dtype == object):
+            # Dense arrival (all rows equal length — always true at
+            # batch_size=1) must STILL pad to a bucket, or every distinct
+            # length is a fresh XLA compile and the bucket-width promise is
+            # broken.
+            col = np.asarray(col)
+            if col.ndim < 2:
+                raise ValueError('pad_spec field {!r} has scalar rows; '
+                                 'padding needs at least one dimension'
+                                 .format(name))
+            width = col.shape[1]
+            bucket = next((b for b in spec['buckets'] if b >= width), None)
+            if bucket is None:
+                raise ValueError(
+                    'pad_spec field {!r}: row length {} exceeds largest '
+                    'bucket {}'.format(name, width, spec['buckets'][-1]))
+            if bucket != width:
+                padded = np.full((len(col), bucket) + col.shape[2:],
+                                 spec['pad_value'], dtype=col.dtype)
+                padded[:, :width] = col
+                col = padded
+            out[name] = col
+            out[spec['length_field']] = np.full(len(col), width, np.int32)
+            continue
+        rows = [np.asarray(v) for v in col]
+        if any(r.ndim < 1 for r in rows):
+            raise ValueError('pad_spec field {!r} has scalar rows; padding '
+                             'needs at least one dimension'.format(name))
+        lengths = np.asarray([len(r) for r in rows], np.int32)
+        longest = int(lengths.max()) if len(rows) else 0
+        bucket = next((b for b in spec['buckets'] if b >= longest), None)
+        if bucket is None:
+            raise ValueError(
+                'pad_spec field {!r}: row length {} exceeds largest bucket {}'
+                .format(name, longest, spec['buckets'][-1]))
+        first = rows[0]
+        dense = np.full((len(rows), bucket) + first.shape[1:],
+                        spec['pad_value'], dtype=first.dtype)
+        for i, r in enumerate(rows):
+            dense[i, :len(r)] = r
+        out[name] = dense
+        out[spec['length_field']] = lengths
+    return out
+
+
 class JaxLoaderBase(object):
     """Iteration-state guard + auto-reset, mirroring the reference's
     ``LoaderBase`` (``pytorch.py:104-129``)."""
@@ -131,7 +224,7 @@ class JaxDataLoader(JaxLoaderBase):
 
     def __init__(self, reader, batch_size=1, shuffling_queue_capacity=0,
                  transform_fn=None, drop_last=False, seed=None,
-                 inmemory_cache_all=False):
+                 inmemory_cache_all=False, pad_spec=None):
         super(JaxDataLoader, self).__init__(reader)
         if getattr(reader, 'ngram', None) is not None:
             # NGram rows are {offset: namedtuple} dicts; batching them needs
@@ -146,6 +239,16 @@ class JaxDataLoader(JaxLoaderBase):
         self.drop_last = drop_last
         self.seed = seed
         self.inmemory_cache_all = inmemory_cache_all
+        self.pad_spec = validate_pad_spec(pad_spec)
+        if self.pad_spec:
+            schema_fields = getattr(getattr(reader, 'schema', None), 'fields', None)
+            if schema_fields is not None:
+                unknown = set(self.pad_spec) - set(schema_fields)
+                if unknown:    # a typo must fail here, not silently no-op
+                    raise ValueError('pad_spec names unknown fields {} '
+                                     '(reader schema has {})'.format(
+                                         sorted(unknown),
+                                         sorted(schema_fields)))
         self._cache = [] if inmemory_cache_all else None
         self._cache_complete = False
 
@@ -175,6 +278,8 @@ class JaxDataLoader(JaxLoaderBase):
         else:
             gen = self._iter_rows()
         for batch in gen:
+            if self.pad_spec:
+                batch = pad_ragged_batch(batch, self.pad_spec)
             if self.transform_fn is not None:
                 batch = self.transform_fn(batch)
             if self._cache is not None:
@@ -272,18 +377,31 @@ class ShardedJaxLoader(JaxLoaderBase):
 
     def __init__(self, reader, mesh, local_batch_size, batch_axis='data',
                  shuffling_queue_capacity=0, transform_fn=None, seed=None,
-                 inmemory_cache_all=False):
+                 inmemory_cache_all=False, pad_spec=None):
         super(ShardedJaxLoader, self).__init__(reader)
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
         self._jax = jax
         self.mesh = mesh
         self.batch_axis = batch_axis
+        normalized_pad = validate_pad_spec(pad_spec)
+        if normalized_pad:
+            multi = {n for n, s in normalized_pad.items()
+                     if len(s['buckets']) > 1}
+            if multi:
+                # each host buckets on its own local batch: with multiple
+                # buckets, hosts can disagree on the padded width of the same
+                # global step and make_array_from_process_local_data would
+                # assemble inconsistent global shapes (multi-host hang)
+                raise ValueError(
+                    'ShardedJaxLoader needs a single-bucket pad_spec (use '
+                    "'max_len'); fields with multiple buckets: {}".format(
+                        sorted(multi)))
         self._loader = JaxDataLoader(
             reader, batch_size=local_batch_size,
             shuffling_queue_capacity=shuffling_queue_capacity,
             transform_fn=transform_fn, drop_last=True, seed=seed,
-            inmemory_cache_all=inmemory_cache_all)
+            inmemory_cache_all=inmemory_cache_all, pad_spec=pad_spec)
         self._pspec = PartitionSpec(batch_axis)
         self._named_sharding = NamedSharding(mesh, self._pspec)
 
@@ -307,7 +425,8 @@ class ShardedJaxLoader(JaxLoaderBase):
 
 def make_jax_loader(reader, batch_size=1, mesh=None, batch_axis='data',
                     shuffling_queue_capacity=0, transform_fn=None,
-                    drop_last=False, seed=None, inmemory_cache_all=False):
+                    drop_last=False, seed=None, inmemory_cache_all=False,
+                    pad_spec=None):
     """Factory: plain host loader when ``mesh is None``, else a sharded loader.
 
     With a mesh, ``batch_size`` is the **per-process** batch size; the global
@@ -317,11 +436,13 @@ def make_jax_loader(reader, batch_size=1, mesh=None, batch_axis='data',
         return JaxDataLoader(reader, batch_size=batch_size,
                              shuffling_queue_capacity=shuffling_queue_capacity,
                              transform_fn=transform_fn, drop_last=drop_last,
-                             seed=seed, inmemory_cache_all=inmemory_cache_all)
+                             seed=seed, inmemory_cache_all=inmemory_cache_all,
+                             pad_spec=pad_spec)
     return ShardedJaxLoader(reader, mesh, batch_size, batch_axis=batch_axis,
                             shuffling_queue_capacity=shuffling_queue_capacity,
                             transform_fn=transform_fn, seed=seed,
-                            inmemory_cache_all=inmemory_cache_all)
+                            inmemory_cache_all=inmemory_cache_all,
+                            pad_spec=pad_spec)
 
 
 def epoch_cache_on_device(loader, sharding=None):

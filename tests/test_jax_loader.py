@@ -207,3 +207,113 @@ class TestPrefetch:
         next(it)
         with pytest.raises(ValueError, match='downstream failure'):
             list(it)
+
+
+class TestRaggedPadding:
+    """pad_spec: variable-length fields become dense bucketed device arrays
+    (SURVEY §7 'hard parts': pad-to-bucket vs XLA's static-shape world)."""
+
+    @pytest.fixture(scope='class')
+    def ragged_url(self, tmp_path_factory):
+        from petastorm_tpu import materialize_dataset
+        from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        schema = Unischema('Ragged', [
+            UnischemaField('id', np.int64, (), ScalarCodec(), False),
+            UnischemaField('tokens', np.int32, (None,), NdarrayCodec(), False)])
+        url = 'file://' + str(tmp_path_factory.mktemp('ragged') / 'ds')
+        rng = np.random.default_rng(0)
+        with materialize_dataset(url, schema) as w:
+            w.write_rows({'id': np.int64(i),
+                          'tokens': rng.integers(1, 100, 3 + i % 20).astype(np.int32)}
+                         for i in range(40))
+        return url
+
+    def test_unit_pad_and_lengths(self):
+        from petastorm_tpu.jax_utils import pad_ragged_batch, validate_pad_spec
+        col = np.empty(3, dtype=object)
+        col[0] = np.array([1, 2], np.int32)
+        col[1] = np.array([3], np.int32)
+        col[2] = np.array([4, 5, 6], np.int32)
+        spec = validate_pad_spec({'tokens': {'buckets': [2, 4, 8],
+                                             'pad_value': -1}})
+        out = pad_ragged_batch({'tokens': col}, spec)
+        assert out['tokens'].shape == (3, 4)        # bucket 4 covers max len 3
+        np.testing.assert_array_equal(out['tokens_len'], [2, 1, 3])
+        np.testing.assert_array_equal(out['tokens'][1], [3, -1, -1, -1])
+
+    def test_bucket_overflow_raises(self):
+        from petastorm_tpu.jax_utils import pad_ragged_batch, validate_pad_spec
+        col = np.empty(1, dtype=object)
+        col[0] = np.arange(10, dtype=np.int32)
+        spec = validate_pad_spec({'t': {'max_len': 4}})
+        with pytest.raises(ValueError, match='exceeds largest bucket'):
+            pad_ragged_batch({'t': col}, spec)
+
+    def test_spec_validation(self):
+        from petastorm_tpu.jax_utils import validate_pad_spec
+        with pytest.raises(ValueError, match='exactly one of'):
+            validate_pad_spec({'t': {}})
+        with pytest.raises(ValueError, match='unknown keys'):
+            validate_pad_spec({'t': {'max_len': 4, 'bukets': [2]}})
+        with pytest.raises(ValueError, match='positive'):
+            validate_pad_spec({'t': {'buckets': [0, 4]}})
+
+    def test_loader_pads_and_jit_consumes(self, ragged_url):
+        import jax
+        import jax.numpy as jnp
+        with make_reader(ragged_url, reader_pool_type='dummy', num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            loader = JaxDataLoader(reader, batch_size=8, drop_last=True,
+                                   pad_spec={'tokens': {'buckets': [8, 16, 32],
+                                                        'pad_value': 0}})
+            batches = list(loader)
+        assert batches
+        for b in batches:
+            assert b['tokens'].dtype == np.int32
+            assert b['tokens'].shape[1] in (8, 16, 32)
+            assert b['tokens_len'].dtype == np.int32
+
+            @jax.jit
+            def masked_sum(tokens, lengths):
+                mask = jnp.arange(tokens.shape[1])[None, :] < lengths[:, None]
+                return jnp.sum(tokens * mask, axis=1)
+
+            dev = masked_sum(jnp.asarray(b['tokens']), jnp.asarray(b['tokens_len']))
+            # padded positions (pad_value 0 here, but mask regardless) excluded
+            expected = [int(row[:n].sum()) for row, n in
+                        zip(b['tokens'], b['tokens_len'])]
+            np.testing.assert_array_equal(np.asarray(dev), expected)
+
+    def test_batch_size_one_still_buckets(self, ragged_url):
+        # a single-row batch arrives DENSE from _collate; it must still pad
+        # to a bucket or every distinct length is a fresh XLA compile
+        with make_reader(ragged_url, reader_pool_type='dummy', num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            loader = JaxDataLoader(reader, batch_size=1,
+                                   pad_spec={'tokens': {'buckets': [32]}})
+            widths = {b['tokens'].shape[1] for b in loader}
+        assert widths == {32}
+
+    def test_unknown_pad_field_fails_fast(self, ragged_url):
+        with make_reader(ragged_url, reader_pool_type='dummy') as reader:
+            with pytest.raises(ValueError, match='unknown fields'):
+                JaxDataLoader(reader, batch_size=4,
+                              pad_spec={'token': {'max_len': 8}})
+
+    def test_sharded_loader_rejects_multi_bucket(self, ragged_url):
+        import jax
+        from jax.sharding import Mesh
+        from petastorm_tpu.jax_utils import ShardedJaxLoader
+        devices = jax.devices('cpu')
+        if len(devices) < 8:
+            pytest.skip('needs 8 CPU devices')
+        mesh = Mesh(np.array(devices[:8]), ('data',))
+        with make_reader(ragged_url, reader_pool_type='dummy') as reader:
+            with pytest.raises(ValueError, match='single-bucket'):
+                ShardedJaxLoader(reader, mesh, 8,
+                                 pad_spec={'tokens': {'buckets': [8, 16]}})
+            loader = ShardedJaxLoader(reader, mesh, 8,
+                                      pad_spec={'tokens': {'max_len': 32}})
+            batch = next(iter(loader))
+            assert batch['tokens'].shape[1] == 32    # global, fixed width
