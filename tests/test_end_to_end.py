@@ -485,3 +485,34 @@ class TestShuffleQuality:
             drop_ids = [row.id for row in reader]
         assert sorted(drop_ids) == sorted(no_drop_ids)   # nothing lost
         assert not contiguous_groups(drop_ids)   # groups split across stream
+
+
+def test_read_after_dataset_moved(tmp_path):
+    """Row-group metadata stores relative paths, so a physically relocated
+    dataset keeps reading (reference 'moved dataset' e2e case)."""
+    import shutil
+    from petastorm_tpu.test_util.dataset_gen import create_test_dataset
+    src = tmp_path / 'original_location'
+    data = create_test_dataset('file://' + str(src), range(30), num_files=3)
+    dst = tmp_path / 'relocated' / 'dataset'
+    dst.parent.mkdir()
+    shutil.move(str(src), str(dst))
+    with make_reader('file://' + str(dst), reader_pool_type='dummy',
+                     shuffle_row_groups=False) as reader:
+        rows = {row.id: row for row in reader}
+    assert set(rows) == {r['id'] for r in data}
+    sample = _row_by_id(data, 7)
+    _assert_rows_equal(rows[7], sample)
+
+
+def test_batch_reader_after_dataset_moved(tmp_path):
+    import shutil
+    from petastorm_tpu.test_util.dataset_gen import create_non_petastorm_dataset
+    src = tmp_path / 'orig'
+    data = create_non_petastorm_dataset('file://' + str(src), 40)
+    dst = tmp_path / 'moved'
+    shutil.move(str(src), str(dst))
+    with make_batch_reader('file://' + str(dst),
+                           reader_pool_type='dummy') as reader:
+        ids = [i for b in reader for i in b.id]
+    assert sorted(ids) == [r['id'] for r in data]
