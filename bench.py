@@ -122,6 +122,7 @@ def main():
                                                      image_size=96)
         imagenet = northstar.run_imagenet_train_bench(
             imagenet_url, batch_size=8, num_steps=4, image_size=96)
+    columnar = northstar.run_columnar_read_bench(mnist_url)
 
     print(json.dumps({
         'metric': 'hello_world_reader_throughput',
@@ -135,6 +136,7 @@ def main():
             'transformer_train': lm.as_dict(),
             'image_decode': img_decode,
             'imagenet_train': imagenet.as_dict(),
+            'columnar_read': columnar,
         },
     }))
 

@@ -169,29 +169,36 @@ def generate_imagenet_dataset(output_url: str, rows: int = 256,
     return output_url
 
 
-def run_image_decode_bench(dataset_url: str, workers_count: int = None,
-                           image_size: int = 224) -> dict:
-    """Pure pipeline throughput: png decode + resize on the worker pool, no
-    accelerator involved (this is where thread vs process pools actually
-    differentiate). Returns {'samples_per_sec': ...}."""
+def _columnar_throughput(dataset_url: str, workers_count=None,
+                         transform_spec=None) -> dict:
+    """Rows/sec through the vectorized columnar reader (optionally with a
+    transform). Timer starts after reader construction so pool spin-up /
+    metadata open don't pollute the number."""
     import time
 
-    from examples.imagenet.main import make_resize_transform
     from petastorm_tpu import make_columnar_reader
 
     n = 0
     with make_columnar_reader(dataset_url, num_epochs=1,
                               reader_pool_type='thread',
                               workers_count=workers_count or _default_workers(),
-                              transform_spec=make_resize_transform(image_size),
+                              transform_spec=transform_spec,
                               shuffle_row_groups=False) as reader:
-        # Timer starts after reader construction so pool spin-up / metadata
-        # open don't pollute the decode-throughput number.
         t0 = time.perf_counter()
         for batch in reader:
             n += len(batch.label)
         dt = time.perf_counter() - t0
     return {'samples': n, 'samples_per_sec': round(n / dt, 2)}
+
+
+def run_image_decode_bench(dataset_url: str, workers_count: int = None,
+                           image_size: int = 224) -> dict:
+    """Pure pipeline throughput: png decode + resize on the worker pool, no
+    accelerator involved (this is where thread vs process pools actually
+    differentiate). Returns {'samples_per_sec': ...}."""
+    from examples.imagenet.main import make_resize_transform
+    return _columnar_throughput(dataset_url, workers_count,
+                                make_resize_transform(image_size))
 
 
 def run_imagenet_train_bench(dataset_url: str, batch_size: int = 32,
@@ -265,3 +272,9 @@ def run_transformer_train_bench(dataset_url: str, batch_size: int = 64,
         return measure_infeed_overlap(
             batches, step_fn, num_steps=num_steps, warmup_steps=warmup_steps,
             count_fn=lambda b: int(b['tokens'].shape[0]))
+
+
+def run_columnar_read_bench(dataset_url: str, workers_count: int = None) -> dict:
+    """Vectorized columnar decode throughput (rows/sec) over a codec dataset —
+    the zero-per-row-Python read path the JAX adapter feeds from."""
+    return _columnar_throughput(dataset_url, workers_count)

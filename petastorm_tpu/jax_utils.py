@@ -409,18 +409,26 @@ class ShardedJaxLoader(JaxLoaderBase):
         return self._loader._cache_hot()
 
     def _iter_impl(self):
-        jax = self._jax
         for batch in self._loader._iter_impl():
-            device, host = {}, {}
-            for name, value in batch.items():
-                if _is_device_compatible(value):
-                    device[name] = jax.make_array_from_process_local_data(
-                        self._named_sharding, value)
-                else:
-                    host[name] = value
-            if host:
-                device['_host'] = host
-            yield device
+            yield stage_to_global(batch, self._named_sharding)
+
+
+def stage_to_global(batch, named_sharding):
+    """Assemble a host batch dict into global ``jax.Array``s over
+    ``named_sharding``; device-incompatible (string/object) columns ride
+    under ``batch['_host']`` untouched — the single definition of the
+    'what can live in HBM' split."""
+    import jax
+    device, host = {}, {}
+    for name, value in batch.items():
+        if _is_device_compatible(value):
+            device[name] = jax.make_array_from_process_local_data(
+                named_sharding, value)
+        else:
+            host[name] = value
+    if host:
+        device['_host'] = host
+    return device
 
 
 def make_jax_loader(reader, batch_size=1, mesh=None, batch_axis='data',
