@@ -186,7 +186,7 @@ def _columnar_throughput(dataset_url: str, workers_count=None,
                               shuffle_row_groups=False) as reader:
         t0 = time.perf_counter()
         for batch in reader:
-            n += len(batch.label)
+            n += len(batch[0])     # any column: row count per batch
         dt = time.perf_counter() - t0
     return {'samples': n, 'samples_per_sec': round(n / dt, 2)}
 

@@ -407,22 +407,33 @@ class ShardedIndexedLoader(IndexedBatchLoader):
                  mesh, batch_axis: str = 'data', **kwargs):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
-        self._jax = jax
-        self._nproc = jax.process_count()
-        self._proc = jax.process_index()
-        if batch_size % self._nproc:
+        nproc = jax.process_count()
+        if batch_size % nproc:
             raise ValueError('global batch_size {} must divide evenly over {} '
-                             'processes'.format(batch_size, self._nproc))
+                             'processes'.format(batch_size, nproc))
+        n_shards = int(mesh.shape[batch_axis])
+        if batch_size % n_shards:
+            raise ValueError(
+                'global batch_size {} must divide evenly over the {} devices '
+                "of mesh axis '{}'".format(batch_size, n_shards, batch_axis))
         super().__init__(dataset, batch_size, **kwargs)
         self.mesh = mesh
         self.batch_axis = batch_axis
         self._sharding = NamedSharding(mesh, PartitionSpec(batch_axis))
+        # Global batch positions owned by THIS process's devices, derived from
+        # the sharding's own device→index map — NOT from process_index block
+        # arithmetic: topology-permuted meshes (mesh_utils.create_device_mesh)
+        # can place a process's devices at non-contiguous global offsets, and
+        # make_array_from_process_local_data lays local data out by that map.
+        idx_map = self._sharding.addressable_devices_indices_map((batch_size,))
+        positions = set()
+        for (sl,) in idx_map.values():
+            positions.update(range(*sl.indices(batch_size)))
+        self._local_positions = np.asarray(sorted(positions), np.int64)
 
     def _assemble(self, epoch: int, batch: int) -> Dict[str, np.ndarray]:
         rows = self._batch_rows(epoch, batch)
-        local = self.batch_size // self._nproc
-        mine = rows[self._proc * local:(self._proc + 1) * local]
-        return self._dataset.gather(mine)
+        return self._dataset.gather(rows[self._local_positions])
 
     def __iter__(self):
         from petastorm_tpu.jax_utils import stage_to_global

@@ -379,9 +379,7 @@ class ShardedJaxLoader(JaxLoaderBase):
                  shuffling_queue_capacity=0, transform_fn=None, seed=None,
                  inmemory_cache_all=False, pad_spec=None):
         super(ShardedJaxLoader, self).__init__(reader)
-        import jax
         from jax.sharding import NamedSharding, PartitionSpec
-        self._jax = jax
         self.mesh = mesh
         self.batch_axis = batch_axis
         normalized_pad = validate_pad_spec(pad_spec)

@@ -1,0 +1,109 @@
+"""Edge scalar types end-to-end: datetime64 and Decimal through the writer,
+both readers, and the adapter sanitizers (reference TestSchema carries
+decimal/date fields; its adapters promote Decimal→string and
+datetime→int64 ns — ``tf_utils.py:27-44``, ``pytorch.py:41-71``)."""
+
+import datetime
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader, materialize_dataset
+from petastorm_tpu.codecs import ScalarCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+EdgeSchema = Unischema('Edge', [
+    UnischemaField('id', np.int64, (), ScalarCodec(), False),
+    UnischemaField('ts', np.datetime64, (), ScalarCodec(), False),
+    UnischemaField('price', Decimal, (), ScalarCodec(), False),
+])
+
+
+@pytest.fixture(scope='module')
+def edge_dataset(tmp_path_factory):
+    url = 'file://' + str(tmp_path_factory.mktemp('edge') / 'ds')
+    rows = [{'id': np.int64(i),
+             'ts': np.datetime64('2024-01-01T00:00:00') + np.timedelta64(i, 'h'),
+             'price': Decimal('19.99') + Decimal(i)}
+            for i in range(20)]
+    with materialize_dataset(url, EdgeSchema) as w:
+        w.write_rows(rows)
+    return url, rows
+
+
+class TestRowReader:
+    def test_datetime_value_exact(self, edge_dataset):
+        url, rows = edge_dataset
+        with make_reader(url, shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            got = {int(r.id): r for r in reader}
+        for expected in rows:
+            out = got[int(expected['id'])].ts
+            assert np.datetime64(out, 'ns') == np.datetime64(expected['ts'], 'ns')
+
+    def test_decimal_round_trips_exactly(self, edge_dataset):
+        url, rows = edge_dataset
+        with make_reader(url, shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            got = {int(r.id): r for r in reader}
+        for expected in rows:
+            # stored as a string: exact decimal text survives
+            assert Decimal(got[int(expected['id'])].price) == expected['price']
+
+
+class TestForeignStore:
+    @pytest.fixture(scope='class')
+    def foreign_url(self, tmp_path_factory):
+        path = tmp_path_factory.mktemp('edge_foreign') / 'ds'
+        path.mkdir()
+        table = pa.table({
+            'id': pa.array(range(10), pa.int64()),
+            'when': pa.array([datetime.datetime(2024, 3, 1, i) for i in range(10)],
+                             pa.timestamp('us')),
+            'amount': pa.array([Decimal('1.50') * i for i in range(10)],
+                               pa.decimal128(10, 2)),
+        })
+        pq.write_table(table, str(path / 'part_0.parquet'))
+        return 'file://' + str(path)
+
+    def test_inferred_schema_and_values(self, foreign_url):
+        with make_batch_reader(foreign_url, reader_pool_type='dummy') as reader:
+            assert np.dtype(reader.schema.fields['when'].numpy_dtype).kind == 'M'
+            batch = next(reader)
+        whens = np.asarray(batch.when, dtype='datetime64[us]')
+        assert whens[3] == np.datetime64('2024-03-01T03:00:00')
+        assert Decimal(str(batch.amount[4])) == Decimal('6.00')
+
+
+class TestAdapterSanitizers:
+    def test_jax_loader_sanitizes(self, edge_dataset):
+        from petastorm_tpu.jax_utils import JaxDataLoader
+        url, rows = edge_dataset
+        with make_reader(url, shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            loader = JaxDataLoader(reader, batch_size=5)
+            batch = next(iter(loader))
+        # datetime64 -> int64 ns; row order within a group is unspecified,
+        # so match per-position via the id column
+        assert batch['ts'].dtype == np.int64
+        by_id = {int(r['id']): r for r in rows}
+        for rid, ts_ns in zip(batch['id'], batch['ts']):
+            expected = np.datetime64(by_id[int(rid)]['ts'], 'ns').astype(np.int64)
+            assert ts_ns == expected
+
+    def test_tf_dataset_sanitizes(self, edge_dataset):
+        tf = pytest.importorskip('tensorflow')
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+        url, rows = edge_dataset
+        with make_reader(url, shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            ds = make_petastorm_dataset(reader)
+            row = next(iter(ds))
+        assert row.ts.dtype == tf.int64
+        assert row.price.dtype == tf.string
+        by_id = {int(r['id']): r for r in rows}
+        expected = by_id[int(row.id.numpy())]['price']
+        assert Decimal(row.price.numpy().decode()) == expected

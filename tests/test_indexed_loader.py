@@ -205,3 +205,28 @@ class TestShardedIndexedLoader:
         with idx.IndexedDatasetReader(url, schema_fields=['idx']) as reader:
             with pytest.raises(ValueError, match='divide evenly'):
                 ShardedIndexedLoader(reader, 16, mesh=mesh, num_epochs=1)
+
+    def test_permuted_mesh_keeps_global_order(self, indexed_dataset, mesh):
+        """Local row slices derive from the sharding's device→index map, not
+        process_index blocks: a topology-permuted device order must produce
+        byte-identical global batches."""
+        import jax
+        from jax.sharding import Mesh
+        from petastorm_tpu.indexed import make_indexed_loader
+        url, _ = indexed_dataset
+        devices = jax.devices('cpu')[:8]
+        mesh_rev = Mesh(np.array(devices[::-1]), ('data',))
+        kw = dict(batch_size=16, num_epochs=1, seed=3, schema_fields=['idx'])
+        a = [np.asarray(b['idx'])
+             for b in make_indexed_loader(url, mesh=mesh, **kw)]
+        b = [np.asarray(x['idx'])
+             for x in make_indexed_loader(url, mesh=mesh_rev, **kw)]
+        assert len(a) == len(b) > 0
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_indivisible_global_batch_fails_fast(self, indexed_dataset, mesh):
+        from petastorm_tpu.indexed import make_indexed_loader
+        url, _ = indexed_dataset
+        with pytest.raises(ValueError, match='devices of mesh axis'):
+            make_indexed_loader(url, batch_size=12, mesh=mesh, num_epochs=1)
