@@ -389,9 +389,10 @@ class ShardedIndexedLoader(IndexedBatchLoader):
     batches over a mesh.
 
     ``batch_size`` is the GLOBAL batch. Every process derives the same
-    (seed, epoch, batch)-addressed permutation slice and gathers only its own
-    ``1/process_count`` contiguous sub-slice; the sub-batches assemble into
-    global arrays via ``jax.make_array_from_process_local_data``. Because the
+    (seed, epoch, batch)-addressed permutation slice and gathers only the
+    rows at the global positions its mesh devices own (from the sharding's
+    device→index map); the sub-batches assemble into global arrays via
+    ``jax.make_array_from_process_local_data``. Because the
     schedule is a pure function of the cursor, all hosts stay in lockstep and
     a restored ``state_dict()`` resumes the identical global stream —
     deterministic, preemption-safe multi-host input (the composition of this
