@@ -211,3 +211,40 @@ def test_process_pool_get_results_timeout():
     finally:
         pool.stop()
         pool.join()
+
+
+class TestExecInNewProcess:
+    """Direct coverage of the spawn-clean-interpreter launcher (reference
+    ``tests/test_run_in_subprocess.py``); the process pool exercises it
+    implicitly, these assert its contract directly."""
+
+    def test_function_runs_in_fresh_interpreter(self, tmp_path, monkeypatch):
+        import os
+        from petastorm_tpu.workers.exec_in_new_process import exec_in_new_process
+
+        # conftest pins JAX_PLATFORMS=cpu in THIS process; set a sentinel so
+        # the child's 'cpu' can only come from the launcher's own pin (the
+        # workers-never-grab-the-TPU invariant), not from inheritance
+        monkeypatch.setenv('JAX_PLATFORMS', 'tpu')
+        marker = str(tmp_path / 'out.txt')
+
+        def write_pid_and_platform(path):
+            import os
+            with open(path, 'w') as f:
+                f.write('{}:{}'.format(os.getpid(),
+                                       os.environ.get('JAX_PLATFORMS', '')))
+
+        proc = exec_in_new_process(write_pid_and_platform, args=(marker,))
+        assert proc.wait(timeout=60) == 0
+        pid_str, platform = open(marker).read().split(':')
+        assert int(pid_str) != os.getpid()      # genuinely a new interpreter
+        assert platform == 'cpu'                # workers never grab the TPU
+
+    def test_nonzero_exit_on_worker_exception(self):
+        from petastorm_tpu.workers.exec_in_new_process import exec_in_new_process
+
+        def boom():
+            raise RuntimeError('worker failed')
+
+        proc = exec_in_new_process(boom)
+        assert proc.wait(timeout=60) != 0
