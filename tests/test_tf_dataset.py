@@ -176,3 +176,47 @@ class TestTfTensorsGraphMode:
         assert int(out[1].ts) == int(out[0].ts) + 1
         np.testing.assert_array_equal(out[0].v,
                                       np.full(2, int(out[0].ts), np.float32))
+
+
+class TestTfFunctionIntegration:
+    """tf.data pipeline consumed inside tf.function / autograph (reference
+    ``tests/test_tf_autograph.py``): tracing must neither fail nor fall back
+    with 'AutoGraph could not transform'."""
+
+    def test_dataset_reduces_under_tf_function(self, scalar_dataset, caplog):
+        caplog.clear()
+        with make_batch_reader(scalar_dataset.url,
+                               schema_fields=['id'],
+                               reader_pool_type='dummy') as reader:
+            ds = make_petastorm_dataset(reader)
+
+            @tf.function
+            def total(dataset):
+                acc = tf.constant(0, tf.int64)
+                for batch in dataset:
+                    acc += tf.reduce_sum(batch.id)
+                return acc
+
+            result = int(total(ds))
+        assert result == sum(r['id'] for r in scalar_dataset.data)
+        assert 'AutoGraph could not transform' not in ' '.join(caplog.messages)
+
+    def test_converter_tf_dataset_under_tf_function(self, tmp_path, caplog):
+        import pyarrow as pa
+        from petastorm_tpu.converter import make_dataset_converter
+        caplog.clear()
+        saved = make_dataset_converter(
+            pa.table({'x': np.arange(100, dtype=np.int64)}),
+            parent_cache_dir_url='file://' + str(tmp_path / 'cache'),
+            delete_at_exit=False)
+        with saved.make_tf_dataset(num_epochs=1) as ds:
+
+            @tf.function
+            def count(dataset):
+                n = tf.constant(0, tf.int64)
+                for batch in dataset:
+                    n += tf.cast(tf.size(batch.x), tf.int64)
+                return n
+
+            assert int(count(ds)) == 100
+        assert 'AutoGraph could not transform' not in ' '.join(caplog.messages)
