@@ -28,6 +28,7 @@ import threading
 import time
 import uuid
 import warnings
+import weakref
 from typing import Dict, Optional
 
 import numpy as np
@@ -129,6 +130,28 @@ def _normalize_precision(table: pa.Table, precision: Optional[str]) -> pa.Table:
     return table.cast(pa.schema(fields)) if changed else table
 
 
+#: id(table) → {params_repr: digest}; arrow tables are immutable, so a live
+#: table object always re-hashes to the same digest and can be memoized by
+#: identity. Keyed by id (pa.Table is weakref-able but not hashable) with a
+#: finalizer evicting the entry when the table dies, so ids can't go stale.
+_fingerprint_memo: Dict[int, Dict[str, str]] = {}
+
+
+def _fingerprint_memo_for(table: pa.Table) -> Dict[str, str]:
+    key = id(table)
+    entry = _fingerprint_memo.get(key)
+    if entry is None:
+        entry = _fingerprint_memo[key] = {}
+        weakref.finalize(table, _fingerprint_memo.pop, key, None)
+    return entry
+
+
+def _params_repr(params: Dict) -> str:
+    """The one canonical serialization of materialization params — used both
+    inside the content hash and as the memo key, which must stay in sync."""
+    return repr(sorted(params.items()))
+
+
 def _fingerprint(table: pa.Table, params: Dict) -> str:
     """Content-addressed cache key: schema + shape + ALL column bytes +
     materialization params.
@@ -139,7 +162,10 @@ def _fingerprint(table: pa.Table, params: Dict) -> str:
     Arrow IPC rather than hashing raw chunk buffers — a sliced table shares
     its parent's buffers, so raw-buffer hashing would collide slices at
     different offsets; IPC serializes exactly the logical region. Hashing is
-    cheap relative to the parquet write it guards."""
+    cheap relative to the parquet write it guards, but still O(data); repeat
+    calls with the same live arrow table skip it via an identity memo at the
+    caller (``make_dataset_converter``)."""
+    params_repr = _params_repr(params)
     h = hashlib.sha256()
     h.update(table.schema.to_string().encode())
     h.update(str(table.num_rows).encode())
@@ -154,7 +180,7 @@ def _fingerprint(table: pa.Table, params: Dict) -> str:
 
     with pa.ipc.new_stream(_HashSink(), table.schema) as writer:
         writer.write_table(table)
-    h.update(repr(sorted(params.items())).encode())
+    h.update(params_repr.encode())
     return h.hexdigest()[:32]
 
 
@@ -282,12 +308,27 @@ def make_dataset_converter(data, parent_cache_dir_url: Optional[str] = None,
     existing materialization with identical content+params) and return a
     picklable :class:`SavedDataset` handle (reference ``make_spark_converter``,
     ``:646-706``)."""
-    table = _normalize_precision(_to_arrow_table(data), precision)
     parent = _get_parent_cache_dir_url(parent_cache_dir_url)
     params = {'compression': compression or 'none',
               'row_group_size_mb': row_group_size_mb,
               'precision': precision or 'none'}
-    key = _fingerprint(table, params)
+    # Memoize the O(data) fingerprint by identity of the ORIGINAL input, but
+    # only for arrow tables — their API is immutable, so a live table object
+    # always re-hashes to the same digest. pandas/Spark inputs are mutable
+    # (a memo there could silently reuse a stale materialization after an
+    # in-place edit), so they pay the full hash every call. Caveat: a table
+    # built zero-copy over a numpy buffer that the caller then mutates
+    # violates arrow's immutability contract and would stale-hit here —
+    # exactly as it would corrupt any other arrow consumer of that table.
+    memo = _fingerprint_memo_for(data) if isinstance(data, pa.Table) else None
+    params_repr = _params_repr(params)
+    key = memo.get(params_repr) if memo is not None else None
+    table = None
+    if key is None:
+        table = _normalize_precision(_to_arrow_table(data), precision)
+        key = _fingerprint(table, params)
+        if memo is not None:
+            memo[params_repr] = key
 
     with _cache_lock:
         cached = _materialized.get(key)
@@ -297,6 +338,9 @@ def make_dataset_converter(data, parent_cache_dir_url: Optional[str] = None,
                 logger.info('Cache hit: reusing %s', cached.cache_dir_url)
                 return cached
             del _materialized[key]
+
+    if table is None:  # memo hit but no live materialization: convert now
+        table = _normalize_precision(_to_arrow_table(data), precision)
 
     # cache dir name mirrors the reference's '{time}-appid-{appid}-{uuid}'
     dir_name = '{}-{}'.format(int(time.time()), uuid.uuid4().hex[:12])

@@ -25,7 +25,9 @@ approximates in the queue-based reader (reference ``reader.py:61-96``).
 from __future__ import annotations
 
 import collections
+import logging
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -37,8 +39,11 @@ from petastorm_tpu.readers.columnar_worker import _column_to_numpy
 from petastorm_tpu.unischema import match_unischema_fields
 from petastorm_tpu.workers import EmptyResultError
 from petastorm_tpu.workers.thread_pool import ThreadPool
+
 from petastorm_tpu.workers.ventilator import BackPressuredVentilator
 from petastorm_tpu.workers.worker_base import WorkerBase
+
+logger = logging.getLogger(__name__)
 
 
 class IndexedDatasetReader:
@@ -288,6 +293,9 @@ class IndexedBatchLoader:
         self._perm_cache: 'collections.OrderedDict[int, np.ndarray]' = \
             collections.OrderedDict()
         self._perm_lock = threading.Lock()
+        # pools whose join() timed out with a thread still alive; their
+        # deferred dataset.close() is retried once the threads are gone
+        self._stale_pools: List = []
 
     # -- deterministic addressing ---------------------------------------------
 
@@ -331,9 +339,36 @@ class IndexedBatchLoader:
 
     # -- iteration -------------------------------------------------------------
 
-    def close(self):
+    def _sweep_stale_pools(self) -> bool:
+        """Drop stale pools whose threads have since exited; True if any
+        remain alive (closing the dataset under them would be unsafe)."""
+        self._stale_pools = [
+            p for p in self._stale_pools
+            if any(t.is_alive() for t in getattr(p, '_threads', []))]
+        return bool(self._stale_pools)
+
+    def close(self, stale_thread_grace_s: float = 5.0):
         """Close the underlying dataset's parquet handles (reopened lazily on
-        any later read, so closing is always safe)."""
+        any later read).
+
+        If a previous iteration's pool join timed out leaving a zombie worker
+        thread, waits up to ``stale_thread_grace_s`` for it to exit, then
+        closes anyway (an explicit close must release the fds; the zombie's
+        in-flight read surfaces an error rather than leaking handles)."""
+        deadline = time.monotonic() + stale_thread_grace_s
+        while self._sweep_stale_pools():
+            if time.monotonic() >= deadline:
+                logger.warning(
+                    'Closing indexed dataset with %d stale worker thread(s) '
+                    'still alive after %.1fs grace; their in-flight reads '
+                    'may fail',
+                    sum(t.is_alive()
+                        for p in self._stale_pools
+                        for t in getattr(p, '_threads', [])),
+                    stale_thread_grace_s)
+                self._stale_pools = []
+                break
+            time.sleep(0.05)
         self._dataset.close()
 
     def __enter__(self):
@@ -351,6 +386,11 @@ class IndexedBatchLoader:
     def __iter__(self):
         if self.epoch >= self.num_epochs:
             return
+        # retry any close deferred by a previous iteration whose pool join
+        # timed out with a live thread (avoids fd accumulation on loaders
+        # iterated repeatedly without close()/context-manager use)
+        if self._stale_pools and not self._sweep_stale_pools():
+            self._dataset.close()
         pool = ThreadPool(self.workers_count,
                           results_queue_size=self.prefetch_batches)
         ventilator = _ScheduleVentilator(
@@ -380,7 +420,9 @@ class IndexedBatchLoader:
             # fresh threads open their own) — but only once the threads are
             # really gone: join() times out rather than verifying exit, and
             # closing a file under a zombie reader corrupts its last read
-            if not any(t.is_alive() for t in getattr(pool, '_threads', [])):
+            if any(t.is_alive() for t in getattr(pool, '_threads', [])):
+                self._stale_pools.append(pool)   # close retried later
+            else:
                 self._dataset.close()
 
 

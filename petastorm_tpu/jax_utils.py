@@ -64,8 +64,12 @@ def validate_pad_spec(pad_spec):
     """Normalize/validate a ragged-padding spec at loader construction.
 
     ``pad_spec`` maps field name -> ``{'buckets': [n1, n2, ...]}`` or
-    ``{'max_len': n}``, plus optional ``'pad_value'`` (default 0) and
-    ``'length_field'`` (default ``'<name>_len'``)."""
+    ``{'max_len': n}``, plus optional ``'pad_value'`` (default 0),
+    ``'length_field'`` (default ``'<name>_len'``), ``'dtype'`` and
+    ``'trailing_shape'``. The last two only matter for ZERO-row batches,
+    where neither can be inferred from data; declaring them keeps empty
+    batches dtype/rank-identical to non-empty ones (without them an empty
+    batch falls back to ``pad_value``'s dtype and no trailing dims)."""
     if not pad_spec:
         return None
     normalized = {}
@@ -75,6 +79,8 @@ def validate_pad_spec(pad_spec):
         max_len = spec.pop('max_len', None)
         pad_value = spec.pop('pad_value', 0)
         length_field = spec.pop('length_field', name + '_len')
+        dtype = spec.pop('dtype', None)
+        trailing_shape = spec.pop('trailing_shape', ())
         if spec:
             raise ValueError('pad_spec for {!r} has unknown keys {}'.format(
                 name, sorted(spec)))
@@ -88,7 +94,9 @@ def validate_pad_spec(pad_spec):
             raise ValueError('pad_spec buckets for {!r} must be positive '
                              'ints, got {!r}'.format(name, buckets))
         normalized[name] = {'buckets': buckets, 'pad_value': pad_value,
-                            'length_field': length_field}
+                            'length_field': length_field,
+                            'dtype': None if dtype is None else np.dtype(dtype),
+                            'trailing_shape': tuple(trailing_shape)}
     return normalized
 
 
@@ -133,11 +141,25 @@ def pad_ragged_batch(batch, pad_spec):
             out[spec['length_field']] = np.full(len(col), width, np.int32)
             continue
         rows = [np.asarray(v) for v in col]
+        if not rows:
+            # Empty batch: emit an empty dense column at the smallest bucket
+            # so shapes stay bucket-stable even for zero-row batches. dtype
+            # and trailing dims can't be inferred from zero rows — they come
+            # from the spec's 'dtype'/'trailing_shape' declarations when
+            # batch-shape stability across the empty case matters.
+            bucket = spec['buckets'][0]
+            dtype = spec['dtype']
+            if dtype is None:
+                dtype = np.asarray(spec['pad_value']).dtype
+            shape = (0, bucket) + spec['trailing_shape']
+            out[name] = np.empty(shape, dtype=dtype)
+            out[spec['length_field']] = np.empty((0,), np.int32)
+            continue
         if any(r.ndim < 1 for r in rows):
             raise ValueError('pad_spec field {!r} has scalar rows; padding '
                              'needs at least one dimension'.format(name))
         lengths = np.asarray([len(r) for r in rows], np.int32)
-        longest = int(lengths.max()) if len(rows) else 0
+        longest = int(lengths.max())
         bucket = next((b for b in spec['buckets'] if b >= longest), None)
         if bucket is None:
             raise ValueError(

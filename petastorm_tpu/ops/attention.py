@@ -352,6 +352,16 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
 
     ``backend``: 'pallas' forces the TPU kernel, 'jnp' the scan fallback,
     'interpret' the Pallas interpreter (CI on CPU); default picks Pallas on TPU.
+
+    Design note: only the FORWARD runs as a Pallas kernel. The backward
+    (``_flash_backward``) is a memory-efficient jnp kv-block scan that XLA
+    compiles to fused ops — same O(Lq·block_k) live memory as a hand-written
+    kernel, gradients verified equal to reference attention on hardware
+    (``tests/test_flash_attention.py``), but it is not a fused Pallas kernel.
+    Training-step perf parity of ``attention='flash'`` vs 'blockwise' is
+    unmeasured: kernel wall-times through this host's TPU tunnel are not
+    trustworthy (block_until_ready acks early), so only value correctness is
+    claimed here.
     """
     if backend is None:
         backend = 'pallas' if jax.default_backend() == 'tpu' else 'jnp'

@@ -4,7 +4,7 @@ Reference parity: ``petastorm/transform.py`` — ``TransformSpec`` (:27-57),
 ``transform_schema`` (:60-89).
 
 TPU-first addition: a ``TransformSpec`` may declare ``is_batched_jax=True``; the
-JAX adapter (``petastorm_tpu/jaxio``) will then run ``func`` on-device under
+JAX adapter (``petastorm_tpu/jax_utils``) will then run ``func`` on-device under
 ``jax.jit`` over whole batches instead of on the CPU worker.
 """
 
