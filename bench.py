@@ -25,7 +25,9 @@ BASELINE_SAMPLES_PER_SEC = 709.84   # reference docs/benchmarks_tutorial.rst:20-
 DATASET_PATH = '/tmp/petastorm_tpu_hello_world_bench'
 MNIST_PATH = '/tmp/petastorm_tpu_northstar_mnist'
 TOKENS_PATH = '/tmp/petastorm_tpu_northstar_tokens'
-IMAGENET_PATH = '/tmp/petastorm_tpu_northstar_imagenet'
+# '_photo' suffix: regenerated when the synthetic content changed from
+# uniform noise to photo-like fields (stale noise stores must not be reused)
+IMAGENET_PATH = '/tmp/petastorm_tpu_northstar_imagenet_photo'
 
 
 def _probe_platform():
@@ -81,7 +83,9 @@ def main():
     seq_len = 256 if on_tpu else 128
     mnist_path = '{}_{}'.format(MNIST_PATH, mnist_rows)
     tokens_rows = 2048 if on_tpu else 512
-    tokens_path = '{}_{}x{}'.format(TOKENS_PATH, tokens_rows, seq_len)
+    # small row groups: the train benches bound read-ahead in CHUNKS, so a
+    # chunk must be far smaller than the measured window for the bound to bite
+    tokens_path = '{}_{}x{}_rg05'.format(TOKENS_PATH, tokens_rows, seq_len)
     mnist_url = 'file://' + mnist_path
     tokens_url = 'file://' + tokens_path
     _ensure(mnist_path, '_common_metadata',
@@ -89,26 +93,46 @@ def main():
                 mnist_url, rows=mnist_rows))
     _ensure(tokens_path, '_common_metadata',
             lambda: northstar.generate_token_dataset(
-                tokens_url, rows=tokens_rows, seq_len=seq_len))
+                tokens_url, rows=tokens_rows, seq_len=seq_len,
+                row_group_size_mb=0.5))
 
-    imagenet_rows = 256 if on_tpu else 48
+    imagenet_rows = 2048 if on_tpu else 48
     imagenet_path = '{}_{}'.format(IMAGENET_PATH, imagenet_rows)
     imagenet_url = 'file://' + imagenet_path
     _ensure(imagenet_path, '_common_metadata',
             lambda: northstar.generate_imagenet_dataset(
-                imagenet_url, rows=imagenet_rows))
+                imagenet_url, rows=imagenet_rows, row_group_size_mb=1.0))
+    # Real ImageNet is jpeg; a second store exercises the DCT-scaled decode
+    # fast path (decode_hints={'image': {'scale': 2}}) against the png line.
+    imagenet_jpeg_path = '{}_{}_jpeg'.format(IMAGENET_PATH, imagenet_rows)
+    imagenet_jpeg_url = 'file://' + imagenet_jpeg_path
+    _ensure(imagenet_jpeg_path, '_common_metadata',
+            lambda: northstar.generate_imagenet_dataset(
+                imagenet_jpeg_url, rows=imagenet_rows, image_codec='jpeg',
+                row_group_size_mb=1.0))
+    scale_hints = {'image': {'scale': 2}}
 
     if on_tpu:
         mnist = northstar.run_mnist_train_bench(
-            mnist_url, batch_size=mnist_batch, num_steps=60, hidden=2048)
+            mnist_url, batch_size=mnist_batch, num_steps=120, hidden=2048)
         mnist_cached = northstar.run_mnist_cached_train_bench(
             mnist_url, rows=mnist_rows, batch_size=mnist_batch, num_steps=60,
             hidden=2048)
         lm = northstar.run_transformer_train_bench(
             tokens_url, batch_size=64, num_steps=40, seq_len=seq_len)
-        img_decode = northstar.run_image_decode_bench(imagenet_url)
+        # image_size must be COVERED by the scale-2 decode of every image
+        # (smallest is ~150 px after halving the 0.8x-jittered 375 px base):
+        # otherwise the hinted lines would train on upscaled, degraded inputs
+        # while the png line decodes full-res — not a fair comparison.
+        img_decode = northstar.run_image_decode_bench(
+            imagenet_url, image_size=128)
         imagenet = northstar.run_imagenet_train_bench(
-            imagenet_url, batch_size=32, num_steps=20)
+            imagenet_url, batch_size=32, num_steps=200, image_size=128)
+        img_decode_jpeg = northstar.run_image_decode_bench(
+            imagenet_jpeg_url, image_size=128, decode_hints=scale_hints)
+        imagenet_jpeg = northstar.run_imagenet_train_bench(
+            imagenet_jpeg_url, batch_size=32, num_steps=200, image_size=128,
+            decode_hints=scale_hints)
     else:
         mnist = northstar.run_mnist_train_bench(
             mnist_url, batch_size=mnist_batch, num_steps=15, hidden=256)
@@ -122,6 +146,11 @@ def main():
                                                      image_size=96)
         imagenet = northstar.run_imagenet_train_bench(
             imagenet_url, batch_size=8, num_steps=4, image_size=96)
+        img_decode_jpeg = northstar.run_image_decode_bench(
+            imagenet_jpeg_url, image_size=96, decode_hints=scale_hints)
+        imagenet_jpeg = northstar.run_imagenet_train_bench(
+            imagenet_jpeg_url, batch_size=8, num_steps=4, image_size=96,
+            decode_hints=scale_hints)
     columnar = northstar.run_columnar_read_bench(mnist_url)
 
     print(json.dumps({
@@ -136,6 +165,8 @@ def main():
             'transformer_train': lm.as_dict(),
             'image_decode': img_decode,
             'imagenet_train': imagenet.as_dict(),
+            'image_decode_jpeg_hinted': img_decode_jpeg,
+            'imagenet_train_jpeg_hinted': imagenet_jpeg.as_dict(),
             'columnar_read': columnar,
         },
     }))

@@ -27,7 +27,7 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 '..', '..'))
 
-from examples.imagenet.schema import ImagenetSchema  # noqa: E402
+from examples.imagenet.schema import make_imagenet_schema  # noqa: E402
 from petastorm_tpu.etl.dataset_metadata import materialize_dataset  # noqa: E402
 
 
@@ -55,19 +55,34 @@ def rows_from_directory(input_path: str, limit: int = None):
 
 def synthetic_rows(n: int, classes: int = 16, seed: int = 0,
                    base_hw=(375, 500)):
-    """Realistic-size random images (the reference's ImageNet median is about
-    500x375); shapes jitter so the variable-shape path is exercised."""
+    """Realistic-size, photo-like random images (the reference's ImageNet
+    median is about 500x375); shapes jitter so the variable-shape path is
+    exercised.
+
+    Content is a low-frequency random field plus mild sensor-like noise, not
+    uniform noise: image codec cost tracks the entropy-coded byte count, and
+    real photos compress to tens of KB at these sizes while uniform noise is
+    incompressible — noise images overstate decode cost ~2.5x and bury the
+    DCT-scaled decode path (``decode_hints``) this dataset exists to
+    exercise."""
+    import cv2
     rng = np.random.default_rng(seed)
     for i in range(n):
         h = int(base_hw[0] * rng.uniform(0.8, 1.2))
         w = int(base_hw[1] * rng.uniform(0.8, 1.2))
         label = i % classes
+        small = rng.integers(0, 255, size=(24, 32, 3), dtype=np.uint8)
+        img = cv2.resize(small, (w, h), interpolation=cv2.INTER_CUBIC)
+        img = np.clip(img.astype(np.int16)
+                      + rng.integers(-8, 8, size=img.shape),
+                      0, 255).astype(np.uint8)
         yield {'noun_id': 'n{:08d}'.format(label), 'text': 'class {}'.format(label),
                'label': np.int64(label),
-               'image': rng.integers(0, 255, size=(h, w, 3), dtype=np.uint8)}
+               'image': img}
 
 
-def generate(output_url: str, rows, row_group_size_mb: float = 32.0) -> int:
+def generate(output_url: str, rows, row_group_size_mb: float = 32.0,
+             image_codec: str = 'png') -> int:
     written = 0
 
     def counting():
@@ -76,7 +91,7 @@ def generate(output_url: str, rows, row_group_size_mb: float = 32.0) -> int:
             written += 1
             yield row
 
-    with materialize_dataset(output_url, ImagenetSchema,
+    with materialize_dataset(output_url, make_imagenet_schema(image_codec),
                              row_group_size_mb=row_group_size_mb) as writer:
         writer.write_rows(counting())
     return written
@@ -92,13 +107,18 @@ def main(argv=None):
                         help='generate N synthetic images instead of reading '
                              '--input-path')
     parser.add_argument('--row-group-size-mb', type=float, default=32.0)
+    parser.add_argument('--image-codec', type=str, default='png',
+                        choices=('png', 'jpeg'),
+                        help='stored image codec (jpeg matches real ImageNet '
+                             'files and enables DCT-scaled decode hints)')
     args = parser.parse_args(argv)
 
     if (args.synthetic is None) == (args.input_path is None):
         parser.error('exactly one of --input-path / --synthetic is required')
     rows = (synthetic_rows(args.synthetic) if args.synthetic is not None
             else rows_from_directory(args.input_path, args.limit))
-    n = generate(args.output_url, rows, args.row_group_size_mb)
+    n = generate(args.output_url, rows, args.row_group_size_mb,
+                 image_codec=args.image_codec)
     print('wrote {} rows to {}'.format(n, args.output_url))
 
 

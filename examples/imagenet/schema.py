@@ -1,15 +1,28 @@
 """ImageNet dataset schema (reference parity:
 ``/root/reference/examples/imagenet/schema.py:21-25`` — noun_id, text, and a
-variable-shaped png-compressed RGB image)."""
+variable-shaped png-compressed RGB image).
+
+The reference ETL re-encodes everything to png; real ImageNet source files
+are jpeg, where DCT-scaled decode (``decode_hints={'image': {'scale': 2}}``)
+pays — :func:`make_imagenet_schema` selects the codec."""
 
 import numpy as np
 
 from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
 from petastorm_tpu.unischema import Unischema, UnischemaField
 
-ImagenetSchema = Unischema('ImagenetSchema', [
-    UnischemaField('noun_id', str, (), ScalarCodec(), False),
-    UnischemaField('text', str, (), ScalarCodec(), False),
-    UnischemaField('label', np.int64, (), ScalarCodec(), False),
-    UnischemaField('image', np.uint8, (None, None, 3), CompressedImageCodec('png'), False),
-])
+
+def make_imagenet_schema(image_codec: str = 'png') -> Unischema:
+    """ImageNet schema with the image stored as ``image_codec`` ('png' keeps
+    reference parity and is lossless; 'jpeg' matches real ImageNet files and
+    enables DCT-scaled decode)."""
+    return Unischema('ImagenetSchema', [
+        UnischemaField('noun_id', str, (), ScalarCodec(), False),
+        UnischemaField('text', str, (), ScalarCodec(), False),
+        UnischemaField('label', np.int64, (), ScalarCodec(), False),
+        UnischemaField('image', np.uint8, (None, None, 3),
+                       CompressedImageCodec(image_codec), False),
+    ])
+
+
+ImagenetSchema = make_imagenet_schema('png')

@@ -41,6 +41,21 @@ class DataframeColumnCodec(ABC):
     def decode(self, unischema_field, value):
         """Decode a storable value back to the numpy form declared by the field."""
 
+    def make_cell_decoder(self, unischema_field):
+        """Return a callable decoding ONE cell of this field's column.
+
+        The columnar reader calls this once per column and then invokes the
+        returned callable per cell, so per-column setup (module lookups, flag
+        resolution) hoists out of the hot loop. Cells arrive as zero-copy
+        ``uint8`` ndarray views over the arrow data buffer; this default
+        adapter converts them to ``bytes`` for codecs whose :meth:`decode`
+        expects that. Override for a per-cell fast path."""
+        def decode_cell(cell):
+            return self.decode(
+                unischema_field,
+                cell.tobytes() if isinstance(cell, np.ndarray) else cell)
+        return decode_cell
+
     @abstractmethod
     def arrow_type(self, unischema_field) -> pa.DataType:
         """The pyarrow storage type used for this field's column."""
@@ -126,15 +141,19 @@ _NPY_FAST_HEADER = re.compile(
     rb"'shape': \((\d*(?:, ?\d+)*,?)\), \}\s*$")
 
 
-def _fast_npy_decode(value: bytes):
+def _fast_npy_decode(value):
     """Decode an ``np.save`` payload without ast-based header parsing;
     returns None when the payload is not in the standard v1 form.
+    ``value`` may be ``bytes`` or any buffer-protocol object (the columnar
+    reader passes zero-copy uint8 ndarray views).
 
     Returns a WRITABLE array (one memcpy), matching what ``np.load`` gives
     consumers on the fallback path — an in-place transform must not work for
     one serialization form and crash for another."""
+    if isinstance(value, np.ndarray):
+        value = memoryview(value)
     # magic \x93NUMPY, version (1,0), little-endian u2 header length
-    if len(value) < 10 or value[:8] != b'\x93NUMPY\x01\x00':
+    if len(value) < 10 or bytes(value[:8]) != b'\x93NUMPY\x01\x00':
         return None
     hlen = value[8] | (value[9] << 8)
     header_end = 10 + hlen
@@ -170,6 +189,16 @@ class NdarrayCodec(DataframeColumnCodec):
             return fast
         memfile = io.BytesIO(value)
         return np.load(memfile)
+
+    def make_cell_decoder(self, unischema_field):
+        # _fast_npy_decode and BytesIO both take buffer views directly; no
+        # bytes materialization needed.
+        def decode_cell(cell):
+            fast = _fast_npy_decode(cell)
+            if fast is not None:
+                return fast
+            return np.load(io.BytesIO(cell))
+        return decode_cell
 
     def arrow_type(self, unischema_field):
         return pa.binary()
@@ -231,6 +260,11 @@ class CompressedNdarrayCodec(DataframeColumnCodec):
         memfile = io.BytesIO(value)
         return np.load(memfile)['arr']
 
+    def make_cell_decoder(self, unischema_field):
+        def decode_cell(cell):   # BytesIO accepts buffer views directly
+            return np.load(io.BytesIO(cell))['arr']
+        return decode_cell
+
     def arrow_type(self, unischema_field):
         return pa.binary()
 
@@ -279,10 +313,37 @@ class CompressedImageCodec(DataframeColumnCodec):
     def decode(self, unischema_field, value):
         return self._decode_flag(unischema_field, value, None)
 
+    def make_cell_decoder(self, unischema_field):
+        # Hot-loop variant of decode(): cv2 attribute lookups and the flag
+        # resolve once per column; ndarray cell views feed imdecode directly
+        # (it takes any uint8 array, so no frombuffer for the common case).
+        import cv2
+        imdecode, cvt_color = cv2.imdecode, cv2.cvtColor
+        bgr2rgb, flag = cv2.COLOR_BGR2RGB, cv2.IMREAD_UNCHANGED
+        name = unischema_field.name
+
+        def decode_cell(cell):
+            if not isinstance(cell, np.ndarray):
+                cell = np.frombuffer(cell, np.uint8)
+            img = imdecode(cell, flag)
+            if img is None:
+                raise ValueError(
+                    'cv2.imdecode failed for field {!r}'.format(name))
+            if img.ndim == 3 and img.shape[2] == 3:
+                return cvt_color(img, bgr2rgb)
+            return img
+        return decode_cell
+
     def validate_decode_hint(self, unischema_field, min_shape=None,
-                             allow_upscale=False):
+                             scale=None, allow_upscale=False):
         """Construction-time value check for :meth:`decode_scaled` kwargs —
         bad hint VALUES must fail at the factory, not per-cell in workers."""
+        if min_shape is not None and scale is not None:
+            raise ValueError("decode hint takes 'min_shape' or 'scale', "
+                             'not both')
+        if scale is not None and scale not in (2, 4, 8):
+            raise ValueError('scale must be one of 2, 4, 8 (jpeg DCT '
+                             'denominators), got {!r}'.format(scale))
         if min_shape is not None:
             import operator
             try:        # any 2-sequence of integral values (tuple/list/ndarray)
@@ -295,42 +356,65 @@ class CompressedImageCodec(DataframeColumnCodec):
                     'min_shape must be a (height, width) pair of positive '
                     'ints, got {!r}'.format(min_shape))
 
-    def can_scale(self, unischema_field) -> bool:
-        """Whether :meth:`decode_scaled` can ever reduce this field: jpeg
-        only (png REDUCED rounds instead of ceiling), uint8 only, gray or
-        3-channel, with known spatial dims."""
+    def _scalable_payload(self, unischema_field) -> bool:
+        """Payload-level scalability: jpeg only (png REDUCED rounds instead of
+        ceiling), uint8 only, gray or 3-channel. Spatial dims may be unknown
+        (an explicit ``scale`` hint does not need them)."""
         shape = unischema_field.shape
         return (self._image_codec in ('.jpg', '.jpeg')
                 and np.dtype(unischema_field.numpy_dtype) == np.uint8
                 and shape is not None and len(shape) >= 2
-                and all(s is not None for s in shape[:2])
                 and (len(shape) == 2 or (len(shape) == 3 and shape[2] == 3)))
 
-    def decode_scaled(self, unischema_field, value, min_shape,
-                      allow_upscale=False):
-        """Decode at reduced resolution when the consumer will downscale
-        anyway: picks the largest jpeg DCT denominator (2/4/8, applied during
-        entropy decode — substantially cheaper than decode-then-resize) whose
-        output still covers ``min_shape`` (or, with ``allow_upscale``, stays
-        within one halving of it). Needs the field's stored shape to be fully
-        known; otherwise falls back to a full decode. TPU-first addition (the
-        reference always decodes at full resolution); same trick as
-        torchvision's ``decode_jpeg(..., size=...)``."""
+    def can_scale(self, unischema_field) -> bool:
+        """Whether a ``min_shape`` hint can ever reduce this field: a scalable
+        payload WITH known spatial dims (the denominator choice needs them)."""
+        shape = unischema_field.shape
+        return (self._scalable_payload(unischema_field)
+                and all(s is not None for s in shape[:2]))
+
+    def _reduced_flag(self, unischema_field, denom):
         import cv2
+        color = len(unischema_field.shape) > 2
+        return {2: cv2.IMREAD_REDUCED_COLOR_2 if color else cv2.IMREAD_REDUCED_GRAYSCALE_2,
+                4: cv2.IMREAD_REDUCED_COLOR_4 if color else cv2.IMREAD_REDUCED_GRAYSCALE_4,
+                8: cv2.IMREAD_REDUCED_COLOR_8 if color else cv2.IMREAD_REDUCED_GRAYSCALE_8}[denom]
+
+    def decode_scaled(self, unischema_field, value, min_shape=None,
+                      scale=None, allow_upscale=False):
+        """Decode at reduced resolution when the consumer will downscale
+        anyway — the jpeg DCT denominator (2/4/8) is applied during entropy
+        decode, substantially cheaper than decode-then-resize. TPU-first
+        addition (the reference always decodes at full resolution); same
+        trick as torchvision's ``decode_jpeg(..., size=...)``.
+
+        Two hint forms:
+
+        - ``min_shape=(h, w)``: picks the largest denominator whose output
+          still covers ``min_shape`` (or, with ``allow_upscale``, stays
+          within one halving of it). Needs the field's stored shape to be
+          fully known; otherwise falls back to a full decode.
+        - ``scale=2|4|8``: applies that denominator unconditionally — the
+          form for variable-shape fields (e.g. raw ImageNet), where the
+          caller asserts the reduced size still covers its resize target.
+
+        Either form silently falls back to a full decode for payloads that
+        cannot scale (png, uint16, RGBA)."""
+        if scale is not None:
+            if not self._scalable_payload(unischema_field):
+                return self.decode(unischema_field, value)
+            return self._decode_flag(unischema_field, value,
+                                     self._reduced_flag(unischema_field, scale))
         shape = unischema_field.shape
         if min_shape is None or not self.can_scale(unischema_field):
             return self.decode(unischema_field, value)
         min_h, min_w = int(min_shape[0]), int(min_shape[1])
-        color = len(shape) > 2
-        flags = {2: cv2.IMREAD_REDUCED_COLOR_2 if color else cv2.IMREAD_REDUCED_GRAYSCALE_2,
-                 4: cv2.IMREAD_REDUCED_COLOR_4 if color else cv2.IMREAD_REDUCED_GRAYSCALE_4,
-                 8: cv2.IMREAD_REDUCED_COLOR_8 if color else cv2.IMREAD_REDUCED_GRAYSCALE_8}
         chosen = None
         for denom in (8, 4, 2):
             h, w = -(-shape[0] // denom), -(-shape[1] // denom)
             if (h >= min_h and w >= min_w) or \
                     (allow_upscale and 2 * h >= min_h and 2 * w >= min_w):
-                chosen = flags[denom]
+                chosen = self._reduced_flag(unischema_field, denom)
                 break
         return self._decode_flag(unischema_field, value, chosen)
 

@@ -40,39 +40,69 @@ class ColumnarResultsReader:
         return self._schema.make_batch_namedtuple(**columns)
 
 
+def _binary_cell_views(column: pa.ChunkedArray) -> list:
+    """Zero-copy ``uint8`` ndarray views of every cell of a (large_)binary
+    column; ``None`` for null cells.
+
+    Slicing arrow's offsets+data buffers directly replaces ``to_pylist()``,
+    which materializes a python ``bytes`` copy per cell — measurable per-cell
+    overhead in decode-bound pipelines. The views keep the arrow buffer alive
+    via their ``base`` reference."""
+    cells = []
+    for chunk in column.chunks:
+        n = len(chunk)
+        if not n:
+            continue
+        validity, offsets_buf, data_buf = chunk.buffers()
+        off_dtype = np.dtype(
+            np.int64 if pa.types.is_large_binary(chunk.type) else np.int32)
+        offsets = np.frombuffer(offsets_buf, dtype=off_dtype, count=n + 1,
+                                offset=chunk.offset * off_dtype.itemsize)
+        data = (np.frombuffer(data_buf, dtype=np.uint8)
+                if data_buf is not None else np.empty(0, np.uint8))
+        if chunk.null_count:
+            valid = chunk.is_valid().to_numpy(zero_copy_only=False)
+            cells.extend(
+                data[offsets[i]:offsets[i + 1]] if valid[i] else None
+                for i in range(n))
+        else:
+            cells.extend(data[lo:hi]
+                         for lo, hi in zip(offsets[:-1], offsets[1:]))
+    return cells
+
+
 def _decode_binary_column(column: pa.ChunkedArray, field,
                           decode_override=None) -> np.ndarray:
     """Decode a codec-encoded binary column into (n, *shape) (fixed shapes)
-    or an object array (wildcard shapes, null cells, non-ndarray payloads)."""
-    codec = field.codec
-    raw = column.to_pylist()
-    n = len(raw)
+    or an object array (wildcard shapes, null cells, non-ndarray payloads).
+
+    Cells reach the decoder as zero-copy buffer views and the per-cell
+    callable comes from ``codec.make_cell_decoder`` (per-column setup hoisted
+    out of the loop) — the two halves of keeping this loop pure decode."""
+    n = len(column)
     fixed = field.shape is not None and all(s is not None for s in field.shape)
     if not n:
         if fixed:
             return np.empty((0,) + tuple(field.shape), dtype=field.numpy_dtype)
         return np.empty(0, dtype=object)
-    cell_decode = decode_override or (lambda cell: codec.decode(field, cell))
-    decode = lambda cell: None if cell is None else cell_decode(cell)  # noqa: E731
+    decode = decode_override or field.codec.make_cell_decoder(field)
+    cells = _binary_cell_views(column)
     if fixed and column.null_count == 0:
-        first = decode(raw[0])
+        first = decode(cells[0])
         if isinstance(first, np.ndarray):
             out = np.empty((n,) + first.shape, dtype=first.dtype)
-            out[0] = first
-            for i in range(1, n):
-                out[i] = decode(raw[i])
-            return out
-        # non-ndarray payload (e.g. a bytes ScalarCodec): object column below,
-        # with the already-decoded first element reused
-        out = np.empty(n, dtype=object)
+        else:
+            # non-ndarray payload (e.g. a bytes ScalarCodec): object column,
+            # with the already-decoded first element reused
+            out = np.empty(n, dtype=object)
         out[0] = first
         for i in range(1, n):
-            out[i] = decode(raw[i])
+            out[i] = decode(cells[i])
         return out
     # nulls present or wildcard shape: dense packing impossible
     out = np.empty(n, dtype=object)
-    for i in range(n):
-        out[i] = decode(raw[i])
+    for i, cell in enumerate(cells):
+        out[i] = None if cell is None else decode(cell)
     return out
 
 

@@ -164,6 +164,11 @@ class ProcessPool:
                     self._processed_items += 1
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
+                # Eager end-of-data check (mirrors ThreadPool.get_results):
+                # detect completion on the final accounting message instead of
+                # waiting out the next 100ms poll.
+                if self._all_work_consumed():
+                    raise EmptyResultError()
                 continue
             if isinstance(control, _WorkerError):
                 import sys

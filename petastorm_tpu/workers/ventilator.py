@@ -56,7 +56,12 @@ class BackPressuredVentilator(Ventilator):
         self._max_in_flight = max_in_flight
         self._interval = interval_s
         self._in_flight = 0
-        self._in_flight_lock = threading.Lock()
+        # Condition, not a sleep-poll: a fixed poll period caps ventilation at
+        # ~1/interval items/sec, which throttles the whole pipeline once row
+        # groups are consumed faster than that (small-row-group stores hit
+        # this). processed_item() notifies, so a freed slot is re-filled
+        # immediately; the timeout below only bounds stop-latency.
+        self._slot_cv = threading.Condition()
         self._stop_event = threading.Event()
         self._completed = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -77,23 +82,24 @@ class BackPressuredVentilator(Ventilator):
 
     def _acquire_slot(self) -> bool:
         """Block until an in-flight slot frees up; False if stopped."""
-        while not self._stop_event.is_set():
-            with self._in_flight_lock:
+        with self._slot_cv:
+            while not self._stop_event.is_set():
                 if self._in_flight < self._max_in_flight:
                     self._in_flight += 1
                     return True
-            time.sleep(self._interval)
+                self._slot_cv.wait(timeout=self._interval)
         return False
 
     def processed_item(self):
-        with self._in_flight_lock:
+        with self._slot_cv:
             self._in_flight -= 1
+            self._slot_cv.notify()
 
     def completed(self) -> bool:
         # All items ventilated AND nothing still in flight.
         if not self._completed.is_set():
             return False
-        with self._in_flight_lock:
+        with self._slot_cv:
             return self._in_flight == 0
 
     def fully_ventilated(self) -> bool:
@@ -103,6 +109,8 @@ class BackPressuredVentilator(Ventilator):
     def stop(self):
         self._stop_event.set()
         self._completed.set()
+        with self._slot_cv:
+            self._slot_cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=5)
 

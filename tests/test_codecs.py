@@ -204,3 +204,128 @@ class TestScaledImageDecode:
         schema = Unischema('S', [field])
         with pytest.raises(ValueError, match='min_shape'):
             build_decode_overrides(schema, {'img': {'min_shape': 112}})
+
+
+class TestExplicitScaleHint:
+    """decode_scaled(scale=N): the hint form for variable-shape jpeg fields."""
+
+    def _jpeg_field(self, shape):
+        return UnischemaField('img', np.uint8, shape,
+                              CompressedImageCodec('jpeg'), False)
+
+    def _payload(self, h, w):
+        field = self._jpeg_field((h, w, 3))
+        img = np.random.default_rng(0).integers(0, 255, (h, w, 3)).astype(np.uint8)
+        return CompressedImageCodec('jpeg').encode(field, img)
+
+    @pytest.mark.parametrize('scale', [2, 4, 8])
+    def test_scale_applies_on_wildcard_shape(self, scale):
+        field = self._jpeg_field((None, None, 3))
+        out = field.codec.decode_scaled(field, self._payload(376, 500),
+                                        scale=scale)
+        # jpeg REDUCED_N ceils: ceil(376/N) x ceil(500/N)
+        assert out.shape[:2] == (-(-376 // scale), -(-500 // scale))
+        assert out.shape[2] == 3
+
+    def test_scale_applies_on_known_shape(self):
+        field = self._jpeg_field((376, 500, 3))
+        out = field.codec.decode_scaled(field, self._payload(376, 500), scale=2)
+        assert out.shape[:2] == (188, 250)
+
+    def test_scale_on_png_falls_back_to_full(self):
+        field = UnischemaField('img', np.uint8, (None, None, 3),
+                               CompressedImageCodec('png'), False)
+        img = np.random.default_rng(0).integers(0, 255, (64, 48, 3)).astype(np.uint8)
+        payload = CompressedImageCodec('png').encode(field, img)
+        out = field.codec.decode_scaled(field, payload, scale=8)
+        assert out.shape == (64, 48, 3)
+        np.testing.assert_array_equal(out, img)   # png full decode is lossless
+
+    def test_bad_scale_value_rejected(self):
+        field = self._jpeg_field((None, None, 3))
+        with pytest.raises(ValueError, match='scale'):
+            field.codec.validate_decode_hint(field, scale=3)
+
+    def test_scale_and_min_shape_together_rejected(self):
+        field = self._jpeg_field((376, 500, 3))
+        with pytest.raises(ValueError, match='not both'):
+            field.codec.validate_decode_hint(field, min_shape=(10, 10), scale=2)
+
+    def test_scale_hint_through_build_decode_overrides(self):
+        from petastorm_tpu.codecs import build_decode_overrides
+        from petastorm_tpu.unischema import Unischema
+        field = self._jpeg_field((None, None, 3))
+        schema = Unischema('S', [field])
+        overrides = build_decode_overrides(schema, {'img': {'scale': 2}})
+        out = overrides['img'](self._payload(100, 60))
+        assert out.shape[:2] == (50, 30)
+
+
+class TestCellDecoders:
+    """make_cell_decoder must be value-identical to decode(), for both bytes
+    and zero-copy uint8 ndarray views (the columnar reader's cell layout)."""
+
+    def _views_of(self, payload):
+        arr = np.frombuffer(payload, np.uint8)
+        return [payload, arr]   # bytes and ndarray view forms
+
+    def test_ndarray_codec(self):
+        codec = NdarrayCodec()
+        field = UnischemaField('m', np.float32, (3, 4), codec, False)
+        value = np.arange(12, dtype=np.float32).reshape(3, 4)
+        payload = codec.encode(field, value)
+        decode_cell = codec.make_cell_decoder(field)
+        for cell in self._views_of(payload):
+            np.testing.assert_array_equal(decode_cell(cell), value)
+            out = decode_cell(cell)
+            out += 1   # must be writable, like np.load's result
+
+    def test_ndarray_codec_fallback_header(self):
+        # fortran-order arrays miss the fast-path header regex -> np.load
+        codec = NdarrayCodec()
+        field = UnischemaField('m', np.float32, (3, 4), codec, False)
+        value = np.asfortranarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+        payload = codec.encode(field, value)
+        decode_cell = codec.make_cell_decoder(field)
+        for cell in self._views_of(payload):
+            np.testing.assert_array_equal(decode_cell(cell), value)
+
+    def test_compressed_ndarray_codec(self):
+        codec = CompressedNdarrayCodec()
+        field = UnischemaField('m', np.int64, (10, 10), codec, False)
+        value = np.arange(100, dtype=np.int64).reshape(10, 10)
+        payload = codec.encode(field, value)
+        decode_cell = codec.make_cell_decoder(field)
+        for cell in self._views_of(payload):
+            np.testing.assert_array_equal(decode_cell(cell), value)
+
+    @pytest.mark.parametrize('image_codec,shape', [
+        ('png', (28, 28)),        # grayscale
+        ('png', (16, 20, 3)),     # color: BGR<->RGB conversion on both paths
+        ('jpeg', (32, 32, 3)),
+    ])
+    def test_image_codec(self, image_codec, shape):
+        codec = CompressedImageCodec(image_codec)
+        field = UnischemaField('img', np.uint8, shape, codec, False)
+        value = np.random.default_rng(0).integers(0, 255, shape).astype(np.uint8)
+        payload = codec.encode(field, value)
+        expected = codec.decode(field, payload)
+        decode_cell = codec.make_cell_decoder(field)
+        for cell in self._views_of(payload):
+            np.testing.assert_array_equal(decode_cell(cell), expected)
+
+    def test_image_codec_bad_payload_raises_with_field_name(self):
+        codec = CompressedImageCodec('png')
+        field = UnischemaField('img', np.uint8, (8, 8), codec, False)
+        decode_cell = codec.make_cell_decoder(field)
+        with pytest.raises(ValueError, match='img'):
+            decode_cell(np.frombuffer(b'not an image', np.uint8))
+
+    def test_default_adapter_converts_views_to_bytes(self):
+        # ScalarCodec has no specialized decoder: the ABC default must hand
+        # its decode() bytes, not ndarray views
+        codec = ScalarCodec(np.dtype('S8'))
+        field = UnischemaField('b', bytes, (), codec, False)
+        decode_cell = codec.make_cell_decoder(field)
+        assert decode_cell(np.frombuffer(b'payload', np.uint8)) == b'payload'
+        assert decode_cell(b'payload') == b'payload'

@@ -291,3 +291,117 @@ class TestDecodeHints:
                          decode_hints={'image': {'min_shape': (8, 8)}}) as r:
             assert r.schema.fields['image'].shape == (32, 32, 3)
             assert next(r).image.shape == (32, 32, 3)
+
+
+class TestScaleHintEndToEnd:
+    """decode_hints={'image': {'scale': N}} — the variable-shape jpeg path."""
+
+    @pytest.fixture(scope='class')
+    def jpeg_url(self, tmp_path_factory):
+        from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        schema = Unischema('VarImg', [
+            UnischemaField('id', np.int64, (), ScalarCodec(), False),
+            UnischemaField('image', np.uint8, (None, None, 3),
+                           CompressedImageCodec('jpeg'), False)])
+        url = 'file://' + str(tmp_path_factory.mktemp('scale') / 'ds')
+        rng = np.random.default_rng(0)
+        with materialize_dataset(url, schema, rows_per_file=8) as w:
+            w.write_rows({'id': np.int64(i),
+                          'image': rng.integers(0, 255, (100 + i, 60, 3)).astype(np.uint8)}
+                         for i in range(16))
+        return url
+
+    def test_columnar_scale_hint_halves_dims(self, jpeg_url):
+        from petastorm_tpu.reader import make_columnar_reader
+        with make_columnar_reader(jpeg_url, shuffle_row_groups=False,
+                                  decode_hints={'image': {'scale': 2}}) as r:
+            batch = next(r)
+        # variable-shape: object column of per-row arrays at ceil(h/2)
+        for i, img in enumerate(batch.image):
+            assert img.shape == (-(-(100 + int(batch.id[i])) // 2), 30, 3)
+
+    def test_row_reader_scale_hint(self, jpeg_url):
+        from petastorm_tpu import make_reader
+        with make_reader(jpeg_url, shuffle_row_groups=False,
+                         reader_pool_type='dummy',
+                         decode_hints={'image': {'scale': 4}}) as r:
+            row = next(r)
+        assert row.image.shape == (-(-(100 + int(row.id)) // 4), 15, 3)
+
+    def test_bad_scale_fails_at_construction(self, jpeg_url):
+        from petastorm_tpu import make_reader
+        with pytest.raises(ValueError, match='scale'):
+            make_reader(jpeg_url, decode_hints={'image': {'scale': 3}})
+
+
+class TestBinaryCellViews:
+    """_binary_cell_views must match to_pylist cell-for-cell for every arrow
+    layout the reader can see: plain, chunked, sliced, nulls, large_binary."""
+
+    def _check(self, arr):
+        import pyarrow as pa
+        from petastorm_tpu.readers.columnar_worker import _binary_cell_views
+        chunked = arr if isinstance(arr, pa.ChunkedArray) else pa.chunked_array([arr])
+        views = _binary_cell_views(chunked)
+        expected = chunked.to_pylist()
+        assert len(views) == len(expected)
+        for v, e in zip(views, expected):
+            if e is None:
+                assert v is None
+            else:
+                assert v.tobytes() == e
+
+    def test_plain_binary(self):
+        import pyarrow as pa
+        self._check(pa.array([b'a', b'bb', b'', b'cccc'], type=pa.binary()))
+
+    def test_large_binary(self):
+        import pyarrow as pa
+        self._check(pa.array([b'xy', b'z', b'12345'], type=pa.large_binary()))
+
+    def test_nulls(self):
+        import pyarrow as pa
+        self._check(pa.array([b'a', None, b'cc', None], type=pa.binary()))
+
+    def test_sliced_array(self):
+        import pyarrow as pa
+        arr = pa.array([b'skip', b'a', b'bb', b'ccc'], type=pa.binary())
+        self._check(pa.chunked_array([arr.slice(1, 3)]))
+
+    def test_multiple_chunks(self):
+        import pyarrow as pa
+        chunked = pa.chunked_array([
+            pa.array([b'one', b'two'], type=pa.binary()),
+            pa.array([], type=pa.binary()),
+            pa.array([b'three'], type=pa.binary()),
+        ])
+        self._check(chunked)
+
+    def test_empty_column(self):
+        import pyarrow as pa
+        self._check(pa.chunked_array([pa.array([], type=pa.binary())]))
+
+    def test_nullable_codec_column_end_to_end(self, tmp_path):
+        # null cells must come back as None through the decode path
+        from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+        from petastorm_tpu.reader import make_columnar_reader
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        schema = Unischema('Nullable', [
+            UnischemaField('id', np.int64, (), ScalarCodec(), False),
+            UnischemaField('vec', np.float32, (3,), NdarrayCodec(), True)])
+        url = 'file://' + str(tmp_path / 'nulls')
+        with materialize_dataset(url, schema) as w:
+            w.write_rows({'id': np.int64(i),
+                          'vec': (None if i % 2 else
+                                  np.full(3, i, dtype=np.float32))}
+                         for i in range(8))
+        with make_columnar_reader(url, shuffle_row_groups=False) as r:
+            batch = next(r)
+        for i, vec in zip(batch.id, batch.vec):
+            if i % 2:
+                assert vec is None
+            else:
+                np.testing.assert_array_equal(vec, np.full(3, i, np.float32))
