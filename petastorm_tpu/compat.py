@@ -71,6 +71,12 @@ _ALLOWED_STDLIB = {
     ('builtins', 'list'): list,
     ('builtins', 'dict'): dict,
     ('builtins', 'tuple'): tuple,
+    # str/bytes TYPE objects appear as field numpy_dtype for string fields;
+    # protocol-2 pickles (py2-era petastorm) spell them __builtin__.unicode/str
+    ('builtins', 'str'): str,
+    ('builtins', 'bytes'): bytes,
+    ('__builtin__', 'unicode'): str,
+    ('__builtin__', 'str'): bytes,
 }
 
 _CLASS_SHIMS = {

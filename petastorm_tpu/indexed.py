@@ -33,7 +33,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from petastorm_tpu.errors import NoDataAvailableError
-from petastorm_tpu.etl.dataset_metadata import get_schema, load_row_groups
+from petastorm_tpu.etl.dataset_metadata import (infer_or_load_unischema,
+                                                load_row_groups)
 from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dataset_url_or_urls
 from petastorm_tpu.readers.columnar_worker import _column_to_numpy
 from petastorm_tpu.unischema import match_unischema_fields
@@ -62,7 +63,13 @@ class IndexedDatasetReader:
             raise ValueError('IndexedDatasetReader needs a single dataset url')
         self._filesystem = fs
         self._path = path
-        stored_schema = get_schema(fs, path)
+        # Foreign parquet stores (no petastorm metadata) work too: the schema
+        # is inferred from the arrow footer and row counts come from the
+        # per-footer scan in load_row_groups.
+        stored_schema, self.schema_was_stored = infer_or_load_unischema(fs, path)
+        #: full stored schema — predicates may reference fields outside the
+        #: output view (matches the streaming readers' semantics)
+        self.full_schema = stored_schema
         if schema_fields is not None:
             matched = match_unischema_fields(stored_schema, schema_fields)
             if not matched:
@@ -179,6 +186,42 @@ class IndexedDatasetReader:
                 out[name][mask] = col[idx]
         return out
 
+    def evaluate_predicate(self, predicate) -> np.ndarray:
+        """Global indices of the rows ``predicate`` includes, in dataset order.
+
+        Runs ONCE (decoding only the predicate's fields, bypassing the
+        row-group cache) so the surviving row set is fixed up front — the
+        indexed loader's deterministic batch grid needs a known row universe,
+        unlike the streaming readers' per-row-group pushdown
+        (``readers/columnar_worker.py:_load_with_predicate``). Validated
+        against the FULL stored schema: predicates may use fields outside the
+        ``schema_fields`` view, like the streaming readers allow."""
+        from petastorm_tpu.readers.columnar_worker import (
+            make_partition_columns, predicate_row_mask,
+            validate_predicate_fields)
+        fields = validate_predicate_fields(predicate, self.full_schema)
+        surviving = []
+        for piece_index, piece in enumerate(self.pieces):
+            partition_keys = set(piece.partition_dict.keys())
+            stored = [n for n in fields if n not in partition_keys]
+            n = piece.num_rows
+            cols: Dict[str, np.ndarray] = {}
+            if stored:
+                table = self._parquet_file(piece.path).read_row_group(
+                    piece.row_group, columns=stored)
+                n = table.num_rows
+                for name in stored:
+                    cols[name] = _column_to_numpy(table.column(name),
+                                                  self.full_schema.fields[name])
+            cols.update(make_partition_columns(self.full_schema, piece, n,
+                                               set(fields)))
+            mask = predicate_row_mask(predicate, fields, cols, n)
+            surviving.append(self.row_offsets[piece_index]
+                             + np.nonzero(mask)[0])
+        if not surviving:
+            return np.empty(0, np.int64)
+        return np.concatenate(surviving).astype(np.int64)
+
 
 def epoch_permutation(total_rows: int, row_offsets: np.ndarray, seed, epoch: int,
                       shuffle: bool = True,
@@ -271,7 +314,8 @@ class IndexedBatchLoader:
     def __init__(self, dataset: IndexedDatasetReader, batch_size: int,
                  num_epochs: int = 1, seed: int = 0, shuffle: bool = True,
                  shuffle_window_groups: int = 4, workers_count: int = 4,
-                 prefetch_batches: int = 8):
+                 prefetch_batches: int = 8, predicate=None,
+                 transform_spec=None):
         if num_epochs is None:
             raise ValueError('IndexedBatchLoader needs a finite num_epochs '
                              '(the resume cursor indexes a finite schedule)')
@@ -283,11 +327,40 @@ class IndexedBatchLoader:
         self.shuffle_window_groups = shuffle_window_groups
         self.workers_count = workers_count
         self.prefetch_batches = prefetch_batches
-        self.batches_per_epoch = dataset.total_rows // batch_size
-        if self.batches_per_epoch == 0:
-            raise NoDataAvailableError(
-                'Dataset has {} rows < batch_size {}'.format(
-                    dataset.total_rows, batch_size))
+        self.predicate = predicate
+        self.transform_spec = transform_spec
+        if transform_spec is not None:
+            from petastorm_tpu.transform import transform_schema
+            self.schema = transform_schema(dataset.schema, transform_spec)
+        else:
+            self.schema = dataset.schema
+        try:
+            if predicate is not None:
+                # The surviving row set is fixed ONCE here; the stream stays
+                # a pure function of (dataset, predicate, seed, cursor), so
+                # resume semantics are unchanged. Window shuffling then
+                # operates on the per-piece offsets of the SURVIVORS.
+                self._selection = dataset.evaluate_predicate(predicate)
+                self._perm_offsets = np.searchsorted(
+                    self._selection, dataset.row_offsets, side='left')
+                total = len(self._selection)
+            else:
+                self._selection = None
+                self._perm_offsets = dataset.row_offsets
+                total = dataset.total_rows
+            self.total_rows = int(total)
+            self.batches_per_epoch = total // batch_size
+            if self.batches_per_epoch == 0:
+                raise NoDataAvailableError(
+                    'Dataset has {} rows{} < batch_size {}'.format(
+                        total, ' (after predicate)' if predicate else '',
+                        batch_size))
+        finally:
+            # the predicate scan opened parquet handles on THIS thread; the
+            # worker threads open their own, so release the scan's now — and
+            # a constructor failure must not orphan them either
+            if predicate is not None:
+                dataset.close()
         self.epoch = 0
         self.batch = 0
         self._perm_cache: 'collections.OrderedDict[int, np.ndarray]' = \
@@ -304,8 +377,8 @@ class IndexedBatchLoader:
             perm = self._perm_cache.get(epoch)
             if perm is not None:
                 return perm
-        perm = epoch_permutation(self._dataset.total_rows,
-                                 self._dataset.row_offsets, self.seed, epoch,
+        perm = epoch_permutation(self.total_rows,
+                                 self._perm_offsets, self.seed, epoch,
                                  self.shuffle, self.shuffle_window_groups)
         with self._perm_lock:
             self._perm_cache[epoch] = perm
@@ -315,12 +388,31 @@ class IndexedBatchLoader:
 
     def _batch_rows(self, epoch: int, batch: int) -> np.ndarray:
         """Global row indices of batch ``batch`` in epoch ``epoch`` — the one
-        place batch addressing lives (the sharded subclass sub-slices it)."""
-        return self._permutation(epoch)[batch * self.batch_size:
-                                        (batch + 1) * self.batch_size]
+        place batch addressing lives (the sharded subclass sub-slices it).
+        With a predicate, permutation positions index the SURVIVOR list and
+        map back to dataset row indices here."""
+        positions = self._permutation(epoch)[batch * self.batch_size:
+                                             (batch + 1) * self.batch_size]
+        if self._selection is not None:
+            return self._selection[positions]
+        return positions
+
+    def _apply_transform(self, columns: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Columnar TransformSpec contract (same as the streaming columnar
+        worker): ``func`` gets a dict of column arrays; output is filtered to
+        the transformed schema. Deterministic because the transform is a pure
+        per-batch function of deterministic input."""
+        spec = self.transform_spec
+        if spec is None:
+            return columns
+        if spec.func is not None:
+            columns = spec.func(columns)
+        return {name: columns[name] for name in self.schema.fields
+                if name in columns}
 
     def _assemble(self, epoch: int, batch: int) -> Dict[str, np.ndarray]:
-        return self._dataset.gather(self._batch_rows(epoch, batch))
+        return self._apply_transform(
+            self._dataset.gather(self._batch_rows(epoch, batch)))
 
     # -- checkpoint state ------------------------------------------------------
 
@@ -476,7 +568,11 @@ class ShardedIndexedLoader(IndexedBatchLoader):
 
     def _assemble(self, epoch: int, batch: int) -> Dict[str, np.ndarray]:
         rows = self._batch_rows(epoch, batch)
-        return self._dataset.gather(rows[self._local_positions])
+        # NOTE: the transform runs per-host on this process's local sub-batch,
+        # so it must be ROW-WISE (e.g. decode/resize); a transform that mixes
+        # rows (batch statistics) would see only the local shard.
+        return self._apply_transform(
+            self._dataset.gather(rows[self._local_positions]))
 
     def __iter__(self):
         from petastorm_tpu.jax_utils import stage_to_global
@@ -488,10 +584,16 @@ def make_indexed_loader(dataset_url, batch_size, num_epochs=1, seed=0,
                         shuffle=True, shuffle_window_groups=4,
                         workers_count=4, prefetch_batches=8,
                         schema_fields=None, storage_options=None,
-                        cache_groups=None, mesh=None, batch_axis='data'):
+                        cache_groups=None, mesh=None, batch_axis='data',
+                        predicate=None, transform_spec=None):
     """Factory: :class:`IndexedDatasetReader` + :class:`IndexedBatchLoader`
     (host numpy batches), or :class:`ShardedIndexedLoader` (global
-    ``jax.Array`` batches over ``mesh``, ``batch_size`` global)."""
+    ``jax.Array`` batches over ``mesh``, ``batch_size`` global).
+
+    Works on foreign parquet stores too (schema inferred, row counts from
+    footers). ``predicate`` fixes the surviving row set once at construction;
+    ``transform_spec`` applies the columnar transform contract per batch —
+    both preserve the pure-function-of-cursor resume guarantee."""
     dataset = IndexedDatasetReader(
         dataset_url, schema_fields=schema_fields,
         storage_options=storage_options,
@@ -500,7 +602,8 @@ def make_indexed_loader(dataset_url, batch_size, num_epochs=1, seed=0,
     kwargs = dict(num_epochs=num_epochs, seed=seed, shuffle=shuffle,
                   shuffle_window_groups=shuffle_window_groups,
                   workers_count=workers_count,
-                  prefetch_batches=prefetch_batches)
+                  prefetch_batches=prefetch_batches,
+                  predicate=predicate, transform_spec=transform_spec)
     if mesh is None:
         return IndexedBatchLoader(dataset, batch_size, **kwargs)
     return ShardedIndexedLoader(dataset, batch_size, mesh=mesh,

@@ -153,6 +153,43 @@ def _column_to_numpy(column: pa.ChunkedArray, field,
     return arr
 
 
+def validate_predicate_fields(predicate, schema) -> list:
+    """Predicate field names, validated against ``schema`` (the FULL stored
+    schema — predicates may use fields outside the reader's output view)."""
+    fields = list(predicate.get_fields())
+    unknown = set(fields) - set(schema.fields.keys())
+    if unknown:
+        raise ValueError('Predicate uses unknown fields: {}'.format(
+            sorted(unknown)))
+    return fields
+
+
+def make_partition_columns(schema, piece, n: int, names) -> Dict[str, np.ndarray]:
+    """Synthesize hive-partition-derived columns (constant per piece) for the
+    requested ``names``, typed per ``schema`` when the field is declared."""
+    out = {}
+    for key, value in piece.partition_dict.items():
+        if key in names:
+            field = schema.fields.get(key)
+            typed = cast_partition_value(
+                field.numpy_dtype if field is not None else None, value)
+            if isinstance(typed, str):
+                col = np.empty(n, dtype=object)
+                col[:] = typed
+            else:
+                col = np.full(n, typed)
+            out[key] = col
+    return out
+
+
+def predicate_row_mask(predicate, fields, cols, n: int) -> np.ndarray:
+    """Boolean include-mask from ``predicate.do_include`` over row dicts built
+    from decoded columns."""
+    return np.fromiter(
+        (bool(predicate.do_include({f: cols[f][i] for f in fields}))
+         for i in range(n)), dtype=bool, count=n)
+
+
 class ColumnarWorker(ParquetPieceWorker):
     """Processes ventilated items into published dicts of decoded numpy
     column arrays."""
@@ -186,19 +223,7 @@ class ColumnarWorker(ParquetPieceWorker):
     # -- loading ---------------------------------------------------------------
 
     def _partition_columns(self, piece, n: int, names) -> Dict[str, np.ndarray]:
-        out = {}
-        for key, value in piece.partition_dict.items():
-            if key in names:
-                field = self._full_schema.fields.get(key)
-                typed = cast_partition_value(
-                    field.numpy_dtype if field is not None else None, value)
-                if isinstance(typed, str):
-                    col = np.empty(n, dtype=object)
-                    col[:] = typed
-                else:
-                    col = np.full(n, typed)
-                out[key] = col
-        return out
+        return make_partition_columns(self._full_schema, piece, n, names)
 
     def _decode_table(self, table: pa.Table, names) -> Dict[str, np.ndarray]:
         out = {}
@@ -222,10 +247,7 @@ class ColumnarWorker(ParquetPieceWorker):
         """Decode predicate columns first; decode the remaining columns only at
         matching indices (cheaper than the row path, which decodes entire
         predicate rows eagerly)."""
-        predicate_fields = list(predicate.get_fields())
-        unknown = set(predicate_fields) - set(self._full_schema.fields.keys())
-        if unknown:
-            raise ValueError('Predicate uses unknown fields: {}'.format(sorted(unknown)))
+        predicate_fields = validate_predicate_fields(predicate, self._full_schema)
         pf = self._parquet_file(piece.path)
         pred_table = pf.read_row_group(
             piece.row_group, columns=self._stored_columns(predicate_fields, piece))
@@ -233,9 +255,7 @@ class ColumnarWorker(ParquetPieceWorker):
         pred_cols.update(self._partition_columns(
             piece, pred_table.num_rows, set(predicate_fields)))
         n = pred_table.num_rows
-        mask = np.fromiter(
-            (bool(predicate.do_include({f: pred_cols[f][i] for f in predicate_fields}))
-             for i in range(n)), dtype=bool, count=n)
+        mask = predicate_row_mask(predicate, predicate_fields, pred_cols, n)
         if not mask.any():
             return None
         idx = np.nonzero(mask)[0]
@@ -256,8 +276,6 @@ class ColumnarWorker(ParquetPieceWorker):
         """TransformSpec over a dict of column arrays (the columnar-path
         contract; the row path hands ``func`` one row dict at a time, the arrow
         batch path a pandas frame)."""
-        spec = self._transform_spec
-        if spec.func is not None:
-            columns = spec.func(columns)
-        return {name: columns[name] for name in self._transformed_schema.fields
-                if name in columns}
+        from petastorm_tpu.transform import apply_columnar_transform
+        return apply_columnar_transform(self._transform_spec,
+                                        self._transformed_schema, columns)

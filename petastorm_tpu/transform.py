@@ -66,3 +66,14 @@ def transform_schema(schema: Unischema, transform_spec: TransformSpec) -> Unisch
         fields = {name: field for name, field in fields.items()
                   if name in transform_spec.selected_fields}
     return Unischema(schema._name + '_transformed', list(fields.values()))
+
+
+def apply_columnar_transform(transform_spec: TransformSpec,
+                             transformed_schema: Unischema, columns):
+    """The columnar transform contract, shared by the streaming columnar
+    worker and the indexed loader: ``func`` receives a dict of column arrays;
+    the result is filtered to the transformed schema's fields."""
+    if transform_spec.func is not None:
+        columns = transform_spec.func(columns)
+    return {name: columns[name] for name in transformed_schema.fields
+            if name in columns}

@@ -1,0 +1,170 @@
+"""One-time generator for the committed legacy-petastorm dataset fixture.
+
+Produces ``tests/data/legacy/legacy_dataset/`` — a dataset whose
+``_common_metadata`` carries a PICKLED Unischema under original petastorm's
+key, byte-compatible with what ``petastorm==0.9.x`` writes
+(reference ``etl/dataset_metadata.py:194-205``: ``pickle.dumps(schema)`` of a
+``petastorm.unischema.Unischema`` whose fields reference
+``petastorm.codecs.*`` and ``pyspark.sql.types.*`` instances).
+
+Original petastorm and pyspark are not installable here, so this script
+fabricates modules with the SAME module paths, class names, and attribute
+layouts the reference defines (``unischema.py:179-196``: ``_name``,
+``_fields`` OrderedDict, plus one attribute per field name;
+``codecs.py:218-223``: ``ScalarCodec._spark_type``; ``codecs.py:59-66``:
+``CompressedImageCodec._image_codec``/``_quality``) and pickles through them
+— the resulting byte stream contains exactly the GLOBAL opcodes petastorm's
+own pickles contain, which is what the compat unpickler must survive.
+
+Field values in the data file are deterministic functions of the row index
+so tests can assert exact values without sharing an RNG with this script.
+
+Run from the repo root (writes next to itself)::
+
+    python tests/data/legacy/generate_fixture.py
+"""
+
+import collections
+import io
+import json
+import os
+import pickle
+import sys
+import types
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'legacy_dataset')
+
+UNISCHEMA_KEY = b'dataset-toolkit.unischema.v1'
+ROW_GROUPS_KEY = b'dataset-toolkit.num_row_groups_per_file.v1'
+
+ROWS = 24
+ROW_GROUP_SIZE = 8
+
+
+def _register(module_name, **classes):
+    mod = sys.modules.get(module_name)
+    if mod is None:
+        mod = types.ModuleType(module_name)
+        sys.modules[module_name] = mod
+    for name, cls in classes.items():
+        cls.__module__ = module_name
+        cls.__qualname__ = name
+        setattr(mod, name, cls)
+    return mod
+
+
+def build_petastorm_modules():
+    """Fabricate petastorm/pyspark modules matching the reference's layout."""
+    UnischemaField = collections.namedtuple(
+        'UnischemaField', ['name', 'numpy_dtype', 'shape', 'codec', 'nullable'])
+
+    class Unischema(object):
+        def __init__(self, name, fields):
+            self._name = name
+            self._fields = collections.OrderedDict((f.name, f) for f in fields)
+            for f in fields:            # attribute sugar, pickled too
+                if not hasattr(self, f.name):
+                    setattr(self, f.name, f)
+
+    class ScalarCodec(object):
+        def __init__(self, spark_type):
+            self._spark_type = spark_type
+
+    class NdarrayCodec(object):
+        pass
+
+    class CompressedNdarrayCodec(object):
+        pass
+
+    class CompressedImageCodec(object):
+        def __init__(self, image_codec='png', quality=80):
+            self._image_codec = '.' + image_codec
+            self._quality = quality
+
+    class IntegerType(object):
+        pass
+
+    class StringType(object):
+        pass
+
+    for name in ('petastorm', 'pyspark', 'pyspark.sql'):
+        if name not in sys.modules:
+            sys.modules[name] = types.ModuleType(name)
+    _register('petastorm.unischema', Unischema=Unischema,
+              UnischemaField=UnischemaField)
+    _register('petastorm.codecs', ScalarCodec=ScalarCodec,
+              NdarrayCodec=NdarrayCodec,
+              CompressedNdarrayCodec=CompressedNdarrayCodec,
+              CompressedImageCodec=CompressedImageCodec)
+    _register('pyspark.sql.types', IntegerType=IntegerType,
+              StringType=StringType)
+    return sys.modules['petastorm.unischema'], sys.modules['petastorm.codecs'], \
+        sys.modules['pyspark.sql.types']
+
+
+def row_values(i):
+    """Deterministic field values for row ``i`` (mirrored by the test)."""
+    image = ((np.arange(8 * 6 * 3, dtype=np.int64).reshape(8, 6, 3)
+              * (i + 1)) % 251).astype(np.uint8)
+    matrix = (np.arange(12, dtype=np.float32).reshape(3, 4) + i / 8.0)
+    return {'id': np.int32(i),
+            'sensor_name': 'sensor_{:02d}'.format(i % 4),
+            'image_png': image,
+            'matrix': matrix}
+
+
+def main():
+    uni, cod, sqlt = build_petastorm_modules()
+    import cv2
+
+    schema = uni.Unischema('LegacySchema', [
+        uni.UnischemaField('id', np.int32, (), cod.ScalarCodec(sqlt.IntegerType()), False),
+        uni.UnischemaField('sensor_name', np.unicode_ if hasattr(np, 'unicode_') else str,
+                           (), cod.ScalarCodec(sqlt.StringType()), False),
+        uni.UnischemaField('image_png', np.uint8, (8, 6, 3),
+                           cod.CompressedImageCodec('png'), False),
+        uni.UnischemaField('matrix', np.float32, (3, 4), cod.NdarrayCodec(), False),
+    ])
+    payload = pickle.dumps(schema, protocol=2)
+
+    os.makedirs(OUT, exist_ok=True)
+    ids, names, images, matrices = [], [], [], []
+    for i in range(ROWS):
+        v = row_values(i)
+        ids.append(v['id'])
+        names.append(v['sensor_name'])
+        bgr = cv2.cvtColor(v['image_png'], cv2.COLOR_RGB2BGR)
+        ok, enc = cv2.imencode('.png', bgr)
+        assert ok
+        images.append(enc.tobytes())
+        buf = io.BytesIO()
+        np.save(buf, v['matrix'])
+        matrices.append(buf.getvalue())
+
+    table = pa.table({'id': pa.array(ids, pa.int32()),
+                      'sensor_name': pa.array(names, pa.string()),
+                      'image_png': pa.array(images, pa.binary()),
+                      'matrix': pa.array(matrices, pa.binary())})
+    data_path = os.path.join(OUT, 'part_00000.parquet')
+    pq.write_table(table, data_path, row_group_size=ROW_GROUP_SIZE)
+
+    # petastorm's rowgroup key maps relpath -> NUMBER OF ROW GROUPS (an int,
+    # not per-group row counts — etl/dataset_metadata.py:239)
+    n_groups = pq.ParquetFile(data_path).metadata.num_row_groups
+    rowgroups_json = json.dumps({'part_00000.parquet': n_groups}).encode()
+
+    meta_schema = table.schema.with_metadata({
+        UNISCHEMA_KEY: payload,
+        ROW_GROUPS_KEY: rowgroups_json,
+    })
+    pq.write_metadata(meta_schema, os.path.join(OUT, '_common_metadata'))
+    print('wrote {} ({} rows, {} row groups, pickle {} bytes)'.format(
+        OUT, ROWS, n_groups, len(payload)))
+
+
+if __name__ == '__main__':
+    main()

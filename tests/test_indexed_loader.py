@@ -230,3 +230,184 @@ class TestShardedIndexedLoader:
         url, _ = indexed_dataset
         with pytest.raises(ValueError, match='devices of mesh axis'):
             make_indexed_loader(url, batch_size=12, mesh=mesh, num_epochs=1)
+
+
+class TestForeignStore:
+    """Indexed loading over plain parquet with NO petastorm metadata."""
+
+    @pytest.fixture(scope='class')
+    def foreign_url(self, tmp_path_factory):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        path = tmp_path_factory.mktemp('foreign') / 'plain'
+        path.mkdir()
+        n = 120
+        table = pa.table({'id': np.arange(n, dtype=np.int64),
+                          'value': np.arange(n, dtype=np.float64) * 0.5})
+        pq.write_table(table, str(path / 'part0.parquet'), row_group_size=16)
+        return 'file://' + str(path), n
+
+    def test_schema_inferred_and_values_exact(self, foreign_url):
+        url, n = foreign_url
+        loader = make_indexed_loader(url, batch_size=10, num_epochs=1, seed=1)
+        assert set(loader.schema.fields) == {'id', 'value'}
+        seen = np.sort(np.concatenate([b['id'] for b in loader]))
+        np.testing.assert_array_equal(seen, np.arange(n, dtype=np.int64))
+        loader.close()
+
+    def test_resume_on_foreign_store(self, foreign_url):
+        url, _ = foreign_url
+        full = _stream(make_indexed_loader(url, batch_size=10, num_epochs=2,
+                                           seed=3))
+        probe = make_indexed_loader(url, batch_size=10, num_epochs=2, seed=3)
+        _stream(probe, limit=7)
+        state = probe.state_dict()
+        probe.close()
+        restored = make_indexed_loader(url, batch_size=10, num_epochs=2, seed=3)
+        restored.load_state_dict(state)
+        rest = _stream(restored)
+        assert len(rest) == len(full) - 7
+        for a, b in zip(rest, full[7:]):
+            np.testing.assert_array_equal(a['id'], b['id'])
+            np.testing.assert_array_equal(a['value'], b['value'])
+
+
+class TestIndexedPredicate:
+    def test_predicate_fixes_surviving_rows(self, indexed_dataset):
+        from petastorm_tpu.predicates import in_lambda
+        url, _ = indexed_dataset
+        pred = in_lambda(['idx'], lambda v: v['idx'] % 3 == 0)
+        loader = make_indexed_loader(url, batch_size=8, num_epochs=1, seed=0,
+                                     predicate=pred)
+        expected = np.arange(0, ROWS, 3, dtype=np.int64)
+        assert loader.total_rows == len(expected)
+        seen = np.concatenate([b['idx'] for b in loader])
+        # drop_last may trim a tail; every seen row satisfies the predicate
+        # and no row repeats within the epoch
+        assert np.all(seen % 3 == 0)
+        assert len(np.unique(seen)) == len(seen)
+        assert set(seen).issubset(set(expected))
+        loader.close()
+
+    def test_predicate_stream_deterministic_and_resumable(self, indexed_dataset):
+        from petastorm_tpu.predicates import in_lambda
+        url, _ = indexed_dataset
+        pred = in_lambda(['idx'], lambda v: v['idx'] % 2 == 0)
+        kwargs = dict(batch_size=8, num_epochs=2, seed=11, predicate=pred)
+        full = _stream(make_indexed_loader(url, **kwargs))
+        probe = make_indexed_loader(url, **kwargs)
+        _stream(probe, limit=5)
+        state = probe.state_dict()
+        probe.close()
+        restored = make_indexed_loader(url, workers_count=1, **kwargs)
+        restored.load_state_dict(state)
+        rest = _stream(restored)
+        for a, b in zip(rest, full[5:]):
+            np.testing.assert_array_equal(a['idx'], b['idx'])
+            np.testing.assert_array_equal(a['vec'], b['vec'])
+        assert len(rest) == len(full) - 5
+
+    def test_unknown_predicate_field_fails_fast(self, indexed_dataset):
+        from petastorm_tpu.predicates import in_lambda
+        url, _ = indexed_dataset
+        with pytest.raises(ValueError, match='unknown fields'):
+            make_indexed_loader(url, batch_size=8,
+                                predicate=in_lambda(['nope'], lambda v: True))
+
+    def test_predicate_rejecting_everything_raises(self, indexed_dataset):
+        from petastorm_tpu.errors import NoDataAvailableError
+        from petastorm_tpu.predicates import in_lambda
+        url, _ = indexed_dataset
+        with pytest.raises(NoDataAvailableError, match='after predicate'):
+            make_indexed_loader(url, batch_size=8,
+                                predicate=in_lambda(['idx'], lambda v: False))
+
+
+class TestIndexedTransform:
+    def _resize_spec(self):
+        """ImageNet-style deterministic worker transform: vec (5,) -> first
+        three components scaled (stands in for decode+resize)."""
+        from petastorm_tpu.transform import TransformSpec
+
+        def shrink(columns):
+            columns['vec'] = (columns['vec'][:, :3] * 2.0).astype(np.float32)
+            return columns
+
+        return TransformSpec(shrink,
+                             edit_fields=[('vec', np.float32, (3,), False)],
+                             selected_fields=['idx', 'vec'])
+
+    def test_transform_applied_and_schema_updated(self, indexed_dataset):
+        url, rows = indexed_dataset
+        loader = make_indexed_loader(url, batch_size=8, num_epochs=1, seed=0,
+                                     shuffle=False,
+                                     transform_spec=self._resize_spec())
+        assert loader.schema.fields['vec'].shape == (3,)
+        batch = next(iter(loader))
+        assert batch['vec'].shape == (8, 3)
+        for i, idx in enumerate(batch['idx']):
+            np.testing.assert_allclose(batch['vec'][i],
+                                       rows[int(idx)]['vec'][:3] * 2.0,
+                                       rtol=1e-6)
+        loader.close()
+
+    def test_transform_resume_value_exact(self, indexed_dataset):
+        url, _ = indexed_dataset
+        kwargs = dict(batch_size=8, num_epochs=2, seed=9,
+                      transform_spec=self._resize_spec())
+        full = _stream(make_indexed_loader(url, **kwargs))
+        probe = make_indexed_loader(url, **kwargs)
+        _stream(probe, limit=11)
+        state = probe.state_dict()
+        probe.close()
+        restored = make_indexed_loader(url, workers_count=2, **kwargs)
+        restored.load_state_dict(state)
+        rest = _stream(restored)
+        for a, b in zip(rest, full[11:]):
+            np.testing.assert_array_equal(a['idx'], b['idx'])
+            np.testing.assert_array_equal(a['vec'], b['vec'])
+        assert len(rest) == len(full) - 11
+
+    def test_predicate_and_transform_compose(self, indexed_dataset):
+        from petastorm_tpu.predicates import in_lambda
+        url, rows = indexed_dataset
+        pred = in_lambda(['idx'], lambda v: v['idx'] < 100)
+        loader = make_indexed_loader(url, batch_size=8, num_epochs=1, seed=4,
+                                     predicate=pred,
+                                     transform_spec=self._resize_spec())
+        for batch in loader:
+            assert np.all(batch['idx'] < 100)
+            assert batch['vec'].shape == (8, 3)
+            for i, idx in enumerate(batch['idx']):
+                np.testing.assert_allclose(batch['vec'][i],
+                                           rows[int(idx)]['vec'][:3] * 2.0,
+                                           rtol=1e-6)
+        loader.close()
+
+    def test_sharded_loader_applies_transform(self, indexed_dataset):
+        import jax
+        from petastorm_tpu.parallel import make_mesh
+        url, rows = indexed_dataset
+        mesh = make_mesh({'data': len(jax.devices())})
+        loader = make_indexed_loader(url, batch_size=16, num_epochs=1, seed=2,
+                                     mesh=mesh,
+                                     transform_spec=self._resize_spec())
+        batch = next(iter(loader))
+        assert batch['vec'].shape == (16, 3)
+        vec = np.asarray(batch['vec'])
+        for i, idx in enumerate(np.asarray(batch['idx'])):
+            np.testing.assert_allclose(vec[i], rows[int(idx)]['vec'][:3] * 2.0,
+                                       rtol=1e-6)
+        loader.close()
+
+    def test_predicate_may_use_fields_outside_view(self, indexed_dataset):
+        # matches the streaming readers: predicate fields need not be in the
+        # schema_fields output view
+        from petastorm_tpu.predicates import in_lambda
+        url, _ = indexed_dataset
+        pred = in_lambda(['idx'], lambda v: v['idx'] % 5 == 0)
+        loader = make_indexed_loader(url, batch_size=4, num_epochs=1, seed=0,
+                                     schema_fields=['vec'], predicate=pred)
+        batch = next(iter(loader))
+        assert set(batch.keys()) == {'vec'}
+        loader.close()
