@@ -66,7 +66,7 @@ class IndexedDatasetReader:
         # Foreign parquet stores (no petastorm metadata) work too: the schema
         # is inferred from the arrow footer and row counts come from the
         # per-footer scan in load_row_groups.
-        stored_schema, self.schema_was_stored = infer_or_load_unischema(fs, path)
+        stored_schema, _ = infer_or_load_unischema(fs, path)
         #: full stored schema — predicates may reference fields outside the
         #: output view (matches the streaming readers' semantics)
         self.full_schema = stored_schema
@@ -196,28 +196,51 @@ class IndexedDatasetReader:
         (``readers/columnar_worker.py:_load_with_predicate``). Validated
         against the FULL stored schema: predicates may use fields outside the
         ``schema_fields`` view, like the streaming readers allow."""
+        import pyarrow.parquet as pq
+
         from petastorm_tpu.readers.columnar_worker import (
             make_partition_columns, predicate_row_mask,
             validate_predicate_fields)
         fields = validate_predicate_fields(predicate, self.full_schema)
         surviving = []
-        for piece_index, piece in enumerate(self.pieces):
-            partition_keys = set(piece.partition_dict.keys())
-            stored = [n for n in fields if n not in partition_keys]
-            n = piece.num_rows
-            cols: Dict[str, np.ndarray] = {}
-            if stored:
-                table = self._parquet_file(piece.path).read_row_group(
-                    piece.row_group, columns=stored)
-                n = table.num_rows
-                for name in stored:
-                    cols[name] = _column_to_numpy(table.column(name),
-                                                  self.full_schema.fields[name])
-            cols.update(make_partition_columns(self.full_schema, piece, n,
-                                               set(fields)))
-            mask = predicate_row_mask(predicate, fields, cols, n)
-            surviving.append(self.row_offsets[piece_index]
-                             + np.nonzero(mask)[0])
+        # the scan opens its own short-lived handles (closed on exit, even on
+        # error) rather than registering into the reader's shared handle list:
+        # the dataset object may be shared with live loaders whose in-flight
+        # reads a close() would corrupt
+        scan_files: Dict[str, tuple] = {}
+        try:
+            for piece_index, piece in enumerate(self.pieces):
+                partition_keys = set(piece.partition_dict.keys())
+                stored = [n for n in fields if n not in partition_keys]
+                n = piece.num_rows
+                cols: Dict[str, np.ndarray] = {}
+                if stored:
+                    entry = scan_files.get(piece.path)
+                    if entry is None:
+                        handle = self._filesystem.open(piece.path, 'rb')
+                        try:
+                            entry = (pq.ParquetFile(handle), handle)
+                        except Exception:
+                            handle.close()
+                            raise
+                        scan_files[piece.path] = entry
+                    table = entry[0].read_row_group(piece.row_group,
+                                                    columns=stored)
+                    n = table.num_rows
+                    for name in stored:
+                        cols[name] = _column_to_numpy(
+                            table.column(name), self.full_schema.fields[name])
+                cols.update(make_partition_columns(self.full_schema, piece, n,
+                                                   set(fields)))
+                mask = predicate_row_mask(predicate, fields, cols, n)
+                surviving.append(self.row_offsets[piece_index]
+                                 + np.nonzero(mask)[0])
+        finally:
+            for _, handle in scan_files.values():
+                try:
+                    handle.close()
+                except OSError:
+                    pass
         if not surviving:
             return np.empty(0, np.int64)
         return np.concatenate(surviving).astype(np.int64)
@@ -334,33 +357,28 @@ class IndexedBatchLoader:
             self.schema = transform_schema(dataset.schema, transform_spec)
         else:
             self.schema = dataset.schema
-        try:
-            if predicate is not None:
-                # The surviving row set is fixed ONCE here; the stream stays
-                # a pure function of (dataset, predicate, seed, cursor), so
-                # resume semantics are unchanged. Window shuffling then
-                # operates on the per-piece offsets of the SURVIVORS.
-                self._selection = dataset.evaluate_predicate(predicate)
-                self._perm_offsets = np.searchsorted(
-                    self._selection, dataset.row_offsets, side='left')
-                total = len(self._selection)
-            else:
-                self._selection = None
-                self._perm_offsets = dataset.row_offsets
-                total = dataset.total_rows
-            self.total_rows = int(total)
-            self.batches_per_epoch = total // batch_size
-            if self.batches_per_epoch == 0:
-                raise NoDataAvailableError(
-                    'Dataset has {} rows{} < batch_size {}'.format(
-                        total, ' (after predicate)' if predicate else '',
-                        batch_size))
-        finally:
-            # the predicate scan opened parquet handles on THIS thread; the
-            # worker threads open their own, so release the scan's now — and
-            # a constructor failure must not orphan them either
-            if predicate is not None:
-                dataset.close()
+        if predicate is not None:
+            # The surviving row set is fixed ONCE here; the stream stays a
+            # pure function of (dataset, predicate, seed, cursor), so resume
+            # semantics are unchanged. Window shuffling then operates on the
+            # per-piece offsets of the SURVIVORS. (The scan manages its own
+            # short-lived file handles — nothing leaks on failure, and a
+            # shared dataset's live handles are untouched.)
+            self._selection = dataset.evaluate_predicate(predicate)
+            self._perm_offsets = np.searchsorted(
+                self._selection, dataset.row_offsets, side='left')
+            total = len(self._selection)
+        else:
+            self._selection = None
+            self._perm_offsets = dataset.row_offsets
+            total = dataset.total_rows
+        self.total_rows = int(total)
+        self.batches_per_epoch = total // batch_size
+        if self.batches_per_epoch == 0:
+            raise NoDataAvailableError(
+                'Dataset has {} rows{} < batch_size {}'.format(
+                    total, ' (after predicate)' if predicate else '',
+                    batch_size))
         self.epoch = 0
         self.batch = 0
         self._perm_cache: 'collections.OrderedDict[int, np.ndarray]' = \
@@ -398,17 +416,15 @@ class IndexedBatchLoader:
         return positions
 
     def _apply_transform(self, columns: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        """Columnar TransformSpec contract (same as the streaming columnar
-        worker): ``func`` gets a dict of column arrays; output is filtered to
-        the transformed schema. Deterministic because the transform is a pure
-        per-batch function of deterministic input."""
-        spec = self.transform_spec
-        if spec is None:
+        """Columnar TransformSpec contract (shared with the streaming
+        columnar worker via ``apply_columnar_transform``). Deterministic
+        because the transform is a pure per-batch function of deterministic
+        input."""
+        if self.transform_spec is None:
             return columns
-        if spec.func is not None:
-            columns = spec.func(columns)
-        return {name: columns[name] for name in self.schema.fields
-                if name in columns}
+        from petastorm_tpu.transform import apply_columnar_transform
+        return apply_columnar_transform(self.transform_spec, self.schema,
+                                        columns)
 
     def _assemble(self, epoch: int, batch: int) -> Dict[str, np.ndarray]:
         return self._apply_transform(

@@ -21,8 +21,11 @@ class NGram:
     """Defines a sliding window over consecutive rows.
 
     :param fields: ``{offset: [UnischemaField | regex string, ...]}`` — which
-        fields are produced at each timestep. Offsets must be consecutive
-        integers (any start).
+        fields are produced at each timestep. Offsets are integers (any
+        start, negative allowed, gaps allowed — a window spans
+        ``max(offsets) - min(offsets) + 1`` consecutive rows and emits
+        entries only for the declared offsets, reference
+        ``tests/test_ngram_end_to_end.py:510-529``).
     :param delta_threshold: maximum allowed timestamp delta between two
         consecutive rows of a window; larger gaps reject the window.
     :param timestamp_field: the :class:`UnischemaField` (or name) ordering rows.
@@ -33,11 +36,22 @@ class NGram:
     def __init__(self, fields: Dict[int, List], delta_threshold,
                  timestamp_field: Union[UnischemaField, str],
                  timestamp_overlap: bool = True):
-        offsets = sorted(fields.keys())
-        if not offsets:
+        if not fields:
             raise ValueError('NGram fields must have at least one timestep')
-        if offsets != list(range(offsets[0], offsets[0] + len(offsets))):
-            raise ValueError('NGram offsets must be consecutive integers, got {}'.format(offsets))
+        if not all(isinstance(k, int) for k in fields.keys()):
+            raise TypeError('NGram offsets must be integers, got {}'.format(
+                sorted(map(repr, fields.keys()))))
+        if not all(isinstance(v, (list, tuple)) for v in fields.values()):
+            raise TypeError('NGram fields values must be lists of fields')
+        import numbers
+        from datetime import timedelta
+        # numbers.Number covers int/float/np scalars/Decimal; timedelta for
+        # datetime-typed timestamp fields — anything the window comparison
+        # itself supports must pass
+        if not isinstance(delta_threshold, (numbers.Number, timedelta)):
+            raise TypeError('delta_threshold must be numeric, got {!r}'
+                            .format(delta_threshold))
+        self._offsets = sorted(fields.keys())
         self._fields = {k: list(v) for k, v in fields.items()}
         self._delta_threshold = delta_threshold
         self._timestamp_field = timestamp_field
@@ -58,7 +72,10 @@ class NGram:
 
     @property
     def length(self) -> int:
-        return len(self._fields)
+        """Window SPAN in rows: ``max(offsets) - min(offsets) + 1`` (equals
+        the timestep count only when offsets are consecutive — gapped offsets
+        still consume the in-between rows, reference ``ngram.py:127-139``)."""
+        return self._offsets[-1] - self._offsets[0] + 1
 
     @property
     def timestamp_field_name(self) -> str:
@@ -124,7 +141,8 @@ class NGram:
         happens consumer-side in :meth:`make_namedtuples`."""
         ts_name = self.timestamp_field_name
         rows = sorted(data, key=lambda r: r[ts_name])
-        offsets = sorted(self._fields.keys())
+        offsets = self._offsets
+        base = offsets[0]
         ngrams = []
         previous_window_end_ts = None
         for start in range(len(rows) - self.length + 1):
@@ -135,7 +153,8 @@ class NGram:
                     and window[0][ts_name] <= previous_window_end_ts):
                 continue
             ngram = {}
-            for offset, row in zip(offsets, window):
+            for offset in offsets:   # gapped offsets skip the rows between
+                row = window[offset - base]
                 view = self._timestep_view(schema, offset)
                 ngram[offset] = {name: row[name] for name in view.fields}
             ngrams.append(ngram)

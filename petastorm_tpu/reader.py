@@ -19,7 +19,6 @@ TPU-first deviations:
 from __future__ import annotations
 
 import logging
-import warnings
 from typing import List, Optional
 
 from petastorm_tpu.cache import LocalDiskCache, NullCache
@@ -415,9 +414,13 @@ class Reader:
         pieces = [p for _, p in indexed]
         if cur_shard is not None:
             if len(pieces) < shard_count:
-                warnings.warn(
-                    'Dataset has only {} row groups but {} shards were requested; '
-                    'some shards will receive no data'.format(len(pieces), shard_count))
+                # Fail loudly like the reference (reader.py:547-549): a
+                # silently empty shard surprises users — and in SPMD training
+                # it deadlocks the collectives of every other host.
+                raise NoDataAvailableError(
+                    'Dataset has only {} row groups after pruning but {} '
+                    'shards were requested; some shards would receive no '
+                    'data'.format(len(pieces), shard_count))
             pieces = [p for i, p in enumerate(pieces) if i % shard_count == cur_shard]
         return pieces, worker_predicate
 

@@ -129,7 +129,10 @@ class TestRankDetection:
     def test_env_var_mismatch_warns(self, monkeypatch):
         monkeypatch.setenv('HOROVOD_RANK', '1')
         monkeypatch.setenv('HOROVOD_SIZE', '4')
-        saved = make_dataset_converter(_table())
+        # tiny row groups: sharding now REFUSES datasets with fewer row
+        # groups than shards, and this store must survive shard_count=2
+        saved = make_dataset_converter(_table(2000),
+                                       row_group_size_mb=0.001)
         with pytest.warns(UserWarning, match='rank 1 of 4'):
             with saved.make_jax_loader(batch_size=10, num_epochs=1,
                                        reader_pool_type='dummy',

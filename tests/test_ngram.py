@@ -49,9 +49,22 @@ def test_ngram_form_windows_unit():
     assert [g[0].ts for g in grams] == [0, 1, 10]
 
 
-def test_ngram_offsets_must_be_consecutive():
-    with pytest.raises(ValueError, match='consecutive'):
-        NGram({0: ['a'], 2: ['b']}, delta_threshold=1, timestamp_field='ts')
+def test_ngram_gapped_offsets_span_rows():
+    # gaps are legal (reference test_non_consecutive_ngram): the window spans
+    # max-min+1 rows and emits only the declared offsets
+    ngram = NGram({0: ['a'], 2: ['b']}, delta_threshold=1, timestamp_field='ts')
+    assert ngram.length == 3
+
+
+def test_ngram_rejects_bad_construction():
+    with pytest.raises(ValueError, match='at least one'):
+        NGram({}, delta_threshold=1, timestamp_field='ts')
+    with pytest.raises(TypeError, match='integers'):
+        NGram({'x': ['a']}, delta_threshold=1, timestamp_field='ts')
+    with pytest.raises(TypeError, match='lists'):
+        NGram({0: 'a'}, delta_threshold=1, timestamp_field='ts')
+    with pytest.raises(TypeError, match='numeric'):
+        NGram({0: ['a']}, delta_threshold='big', timestamp_field='ts')
 
 
 def test_ngram_non_overlap():

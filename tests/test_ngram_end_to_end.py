@@ -188,3 +188,261 @@ class TestEpochs:
             starts = [int(g[0].ts) for g in reader]
         one_epoch = sorted(list(range(29)) + list(range(40, 59)))
         assert sorted(starts) == sorted(one_epoch * 3)
+
+
+class TestRegexFields:
+    """Per-timestep REGEX schema views (reference
+    ``test_ngram_with_regex_fields`` / ``test_ngram_regex_field_resolve``,
+    ``tests/test_ngram_end_to_end.py:574-637``): regex strings in the fields
+    dict resolve against the dataset schema per timestep."""
+
+    def test_regex_fields_resolve_and_read(self, gapped_dataset):
+        url, _ = gapped_dataset
+        ngram = NGram({0: ['^ts$', '^val.*$'], 1: ['^ts$', 'label']},
+                      delta_threshold=1, timestamp_field='ts')
+        with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            grams = list(reader)
+        assert grams
+        for g in grams:
+            assert set(g[0]._fields) == {'ts', 'value'}
+            assert set(g[1]._fields) == {'ts', 'label'}
+            ts0 = int(g[0].ts)
+            np.testing.assert_array_equal(g[0].value,
+                                          np.full(3, ts0, np.float32))
+            assert int(g[1].label) == (ts0 + 1) % 7
+
+    def test_regex_wildcard_selects_everything(self, gapped_dataset):
+        url, _ = gapped_dataset
+        ngram = NGram({0: ['.*'], 1: ['.*']}, delta_threshold=1,
+                      timestamp_field='ts')
+        with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            g = next(reader)
+        assert set(g[0]._fields) == {'ts', 'value', 'label'}
+        assert set(g[1]._fields) == {'ts', 'value', 'label'}
+
+    def test_regex_matching_nothing_fails_fast(self, gapped_dataset):
+        url, _ = gapped_dataset
+        ngram = NGram({0: ['^nope$'], 1: ['ts']}, delta_threshold=1,
+                      timestamp_field='ts')
+        with pytest.raises(ValueError, match='matched no fields'):
+            with make_reader(url, schema_fields=ngram) as reader:
+                next(reader)
+
+    def test_mixed_field_objects_and_regex(self, gapped_dataset):
+        url, _ = gapped_dataset
+        ngram = NGram({0: [SeqSchema.fields['ts'], '^label$'],
+                       1: ['^ts$']},
+                      delta_threshold=1, timestamp_field='ts')
+        with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            g = next(reader)
+        assert set(g[0]._fields) == {'ts', 'label'}
+        assert set(g[1]._fields) == {'ts'}
+
+
+class TestShuffleRowDropInterplay:
+    """timestamp_overlap x shuffle x shuffle_row_drop_partitions (reference
+    ``test_ngram_shuffle_drop_ratio`` + ``test_ngram_basic_longer_no_overlap``,
+    ``tests/test_ngram_end_to_end.py:306-330,531-571``)."""
+
+    @pytest.mark.parametrize('drop_partitions', [2, 4])
+    def test_row_drop_preserves_every_window(self, multi_group_dataset,
+                                             drop_partitions):
+        """shuffle_row_drop splits each row group into separately-ventilated
+        slices for shuffle decorrelation — NOT subsampling. With ngram, each
+        slice carries length-1 continuation rows so boundary windows still
+        form: the full window multiset must survive, value-exact (reference
+        ``test_ngram_shuffle_drop_ratio``, ``py_dict_reader_worker.py:260-273``)."""
+        url, _ = multi_group_dataset
+        ngram = _ngram(length=3, delta_threshold=1)
+        with make_reader(url, schema_fields=ngram, shuffle_row_groups=True,
+                         shuffle_row_drop_partitions=drop_partitions,
+                         seed=3, reader_pool_type='dummy') as reader:
+            grams = list(reader)
+        _assert_window_values_exact(grams, 3)
+        starts = sorted(int(g[0].ts) for g in grams)
+        assert starts == [10 * k + s for k in range(4) for s in range(8)]
+
+    def test_no_overlap_with_drop_1_stays_disjoint(self, multi_group_dataset):
+        url, _ = multi_group_dataset
+        ngram = _ngram(length=3, delta_threshold=1, timestamp_overlap=False)
+        with make_reader(url, schema_fields=ngram, shuffle_row_groups=True,
+                         shuffle_row_drop_partitions=1,
+                         seed=5, reader_pool_type='thread',
+                         workers_count=2) as reader:
+            grams = list(reader)
+        _assert_window_values_exact(grams, 3)
+        seen = [int(g[i].ts) for g in grams for i in range(3)]
+        assert len(seen) == len(set(seen))
+
+    def test_no_overlap_with_drop_gt_1_rejected(self, multi_group_dataset):
+        """timestamp_overlap=False x shuffle_row_drop>1 cannot keep windows
+        disjoint across slice boundaries; refused at construction like the
+        reference (``reader.py:420-422``)."""
+        url, _ = multi_group_dataset
+        ngram = _ngram(length=3, delta_threshold=1, timestamp_overlap=False)
+        with pytest.raises(NotImplementedError,
+                           match='shuffle_row_drop_partitions'):
+            make_reader(url, schema_fields=ngram,
+                        shuffle_row_drop_partitions=2)
+
+    def test_shuffle_changes_window_order_not_content(self, multi_group_dataset):
+        url, _ = multi_group_dataset
+        ngram = _ngram(length=3, delta_threshold=1)
+
+        def starts(seed, shuffle):
+            with make_reader(url, schema_fields=ngram,
+                             shuffle_row_groups=shuffle, seed=seed,
+                             reader_pool_type='dummy') as reader:
+                return [int(g[0].ts) for g in reader]
+
+        plain = starts(seed=0, shuffle=False)
+        shuffled = starts(seed=11, shuffle=True)
+        assert sorted(plain) == sorted(shuffled)
+        # unshuffled: row GROUPS arrive in order (order within a group is a
+        # results-queue implementation detail, not part of the contract)
+        assert [s // 10 for s in plain] == sorted(s // 10 for s in plain)
+
+
+class TestNGramPredicate:
+    """ngram + predicate combination (reference allows predicates with ngram
+    when the predicate uses fields available on workers)."""
+
+    def test_predicate_filters_windows(self, multi_group_dataset):
+        from petastorm_tpu.predicates import in_lambda
+        url, _ = multi_group_dataset
+        ngram = _ngram(length=2, delta_threshold=1)
+        # keep only rows of the first two row groups (ts < 20); windows can
+        # then only form inside those groups
+        pred = in_lambda(['ts'], lambda v: v['ts'] < 20)
+        with make_reader(url, schema_fields=ngram, predicate=pred,
+                         shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            grams = list(reader)
+        _assert_window_values_exact(grams, 2)
+        starts = sorted(int(g[0].ts) for g in grams)
+        assert starts == [10 * k + s for k in range(2) for s in range(9)]
+
+    @pytest.mark.parametrize('pool_type', ['dummy', 'thread'])
+    def test_predicate_creating_gaps_rejects_windows(self, multi_group_dataset,
+                                                     pool_type):
+        from petastorm_tpu.predicates import in_lambda
+        url, _ = multi_group_dataset
+        ngram = _ngram(length=2, delta_threshold=1)
+        # drop every third timestamp: windows may only form on consecutive
+        # surviving pairs
+        pred = in_lambda(['ts'], lambda v: v['ts'] % 3 != 0)
+        with make_reader(url, schema_fields=ngram, predicate=pred,
+                         shuffle_row_groups=False, reader_pool_type=pool_type,
+                         workers_count=2) as reader:
+            grams = list(reader)
+        starts = sorted(int(g[0].ts) for g in grams)
+        expected = [t for t in range(40)
+                    if t % 3 and (t + 1) % 3 and (t % 10) != 9]
+        assert starts == expected
+        for g in grams:
+            assert int(g[1].ts) == int(g[0].ts) + 1
+
+
+class TestValidationAndDegenerateForms:
+    """Constructor validation + the odd-but-legal window shapes (reference
+    ``test_ngram_validation`` :441-474, ``test_ngram_length_1`` :495-508,
+    ``test_non_consecutive_ngram`` :510-519, ``test_shuffled_fields``
+    :521-529)."""
+
+    def test_validation_errors(self):
+        with pytest.raises((ValueError, TypeError)):
+            NGram({}, delta_threshold=1, timestamp_field='ts')
+        with pytest.raises((ValueError, TypeError)):
+            NGram({0: ['ts'], 'not-an-int': ['ts']}, delta_threshold=1,
+                  timestamp_field='ts')
+
+    def test_length_1_ngram(self, gapped_dataset):
+        url, ts = gapped_dataset
+        ngram = _ngram(length=1, delta_threshold=1)
+        with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            grams = list(reader)
+        assert sorted(int(g[0].ts) for g in grams) == sorted(ts)
+
+    def test_non_consecutive_offsets(self, gapped_dataset):
+        # offsets {0, 2}: timestep 1 exists in the window span but carries no
+        # fields; deltas are still checked across the whole span (reference
+        # test_non_consecutive_ngram, offsets {-1, 1})
+        url, _ = gapped_dataset
+        ngram = NGram({0: ['ts', 'value'], 2: ['ts', 'label']},
+                      delta_threshold=1, timestamp_field='ts')
+        with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            grams = list(reader)
+        assert grams
+        for g in grams:
+            assert set(g.keys()) == {0, 2}
+            assert int(g[2].ts) == int(g[0].ts) + 2
+
+    def test_negative_offsets(self, gapped_dataset):
+        # reference's own non-consecutive example uses {-1: ..., 1: ...}
+        url, _ = gapped_dataset
+        ngram = NGram({-1: ['ts', 'value'], 1: ['ts', 'label']},
+                      delta_threshold=1, timestamp_field='ts')
+        with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                         reader_pool_type='dummy') as reader:
+            grams = list(reader)
+        assert grams
+        for g in grams:
+            assert set(g.keys()) == {-1, 1}
+            assert int(g[1].ts) == int(g[-1].ts) + 2
+            np.testing.assert_array_equal(
+                g[-1].value, np.full(3, int(g[-1].ts), np.float32))
+
+    def test_field_list_order_is_irrelevant(self, gapped_dataset):
+        url, _ = gapped_dataset
+        a = NGram({0: ['ts', 'value', 'label'], 1: ['ts']},
+                  delta_threshold=1, timestamp_field='ts')
+        b = NGram({0: ['label', 'value', 'ts'], 1: ['ts']},
+                  delta_threshold=1, timestamp_field='ts')
+        outs = []
+        for ngram in (a, b):
+            with make_reader(url, schema_fields=ngram,
+                             shuffle_row_groups=False,
+                             reader_pool_type='dummy') as reader:
+                outs.append([(int(g[0].ts), int(g[0].label)) for g in reader])
+        assert outs[0] == outs[1]
+
+
+class TestMultiFileShuffle:
+    """Many files x shuffle x thread pool at once (reference
+    ``test_ngram_basic_shuffle_multi_partition`` :267-276), value-exact."""
+
+    @pytest.fixture(scope='class')
+    def eight_file_dataset(self, tmp_path_factory):
+        path = tmp_path_factory.mktemp('ngram_files') / 'ds'
+        ts = list(range(80))
+        return _write_seq_dataset(path, ts, rows_per_file=10), ts
+
+    @pytest.mark.parametrize('pool_type,workers', [
+        ('thread', 4), ('process', 2)])
+    def test_shuffled_multifile_windows_exact(self, eight_file_dataset,
+                                              pool_type, workers):
+        url, _ = eight_file_dataset
+        ngram = _ngram(length=4, delta_threshold=1)
+        with make_reader(url, schema_fields=ngram, shuffle_row_groups=True,
+                         seed=13, reader_pool_type=pool_type,
+                         workers_count=workers) as reader:
+            grams = list(reader)
+        _assert_window_values_exact(grams, 4)
+        starts = sorted(int(g[0].ts) for g in grams)
+        # every 10-row file yields starts 10k..10k+6
+        assert starts == [10 * k + s for k in range(8) for s in range(7)]
+
+    def test_multifile_epochs_consistent(self, eight_file_dataset):
+        url, _ = eight_file_dataset
+        ngram = _ngram(length=4, delta_threshold=1)
+        with make_reader(url, schema_fields=ngram, shuffle_row_groups=True,
+                         seed=3, num_epochs=2,
+                         reader_pool_type='thread', workers_count=2) as reader:
+            starts = [int(g[0].ts) for g in reader]
+        one = [10 * k + s for k in range(8) for s in range(7)]
+        assert sorted(starts) == sorted(one * 2)
