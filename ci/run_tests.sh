@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Reproducible test runner (works in the docker image or any checkout with
+# the deps installed). Mirrors what the round driver runs, plus the type
+# check when mypy is available.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo '== pytest =='
+python -m pytest tests/ -x -q
+
+echo '== multi-chip dry run (8 virtual devices) =='
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python __graft_entry__.py 8 --dryrun-only
+
+if python -c 'import mypy' 2>/dev/null; then
+    echo '== mypy =='
+    python -m mypy --config-file mypy.ini petastorm_tpu
+else
+    echo '== mypy not installed; skipping type check =='
+fi
+
+echo 'ALL CI CHECKS PASSED'

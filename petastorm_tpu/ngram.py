@@ -36,15 +36,15 @@ class NGram:
     def __init__(self, fields: Dict[int, List], delta_threshold,
                  timestamp_field: Union[UnischemaField, str],
                  timestamp_overlap: bool = True):
+        import numbers
+        from datetime import timedelta
         if not fields:
             raise ValueError('NGram fields must have at least one timestep')
-        if not all(isinstance(k, int) for k in fields.keys()):
+        if not all(isinstance(k, numbers.Integral) for k in fields.keys()):
             raise TypeError('NGram offsets must be integers, got {}'.format(
                 sorted(map(repr, fields.keys()))))
         if not all(isinstance(v, (list, tuple)) for v in fields.values()):
             raise TypeError('NGram fields values must be lists of fields')
-        import numbers
-        from datetime import timedelta
         # numbers.Number covers int/float/np scalars/Decimal; timedelta for
         # datetime-typed timestamp fields — anything the window comparison
         # itself supports must pass

@@ -10,6 +10,7 @@ partitioning incl. ngram continuation rows (:260-273), results-queue reader
 
 from __future__ import annotations
 
+import threading
 from typing import List
 
 import numpy as np
@@ -31,16 +32,22 @@ class RowGroupResultsReader:
         self._schema = schema
         self._ngram = ngram
         self._buffer: List = []
+        # Multiple consumer threads may drain one reader concurrently
+        # (reference ``test_multithreaded_reads``): without the lock, two
+        # threads can both see an empty buffer, both fetch a chunk, and one
+        # assignment silently overwrites the other's unconsumed rows.
+        self._lock = threading.Lock()
 
     @property
     def batched_output(self) -> bool:
         return False
 
     def read_next(self, pool):
-        while not self._buffer:
-            # raises EmptyResultError at end of stream; propagates to Reader
-            self._buffer = list(pool.get_results())
-        item = self._buffer.pop()
+        with self._lock:
+            while not self._buffer:
+                # raises EmptyResultError at end of stream; propagates to Reader
+                self._buffer = list(pool.get_results())
+            item = self._buffer.pop()
         if self._ngram:
             # workers ship windows as plain dicts (namedtuple classes of
             # schema views cannot cross the process-pool pickle boundary);
