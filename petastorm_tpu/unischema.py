@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import re
+import threading
 from collections import namedtuple
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -100,17 +101,25 @@ class UnischemaField:
 
 class _NamedtupleCache:
     """Returns the same namedtuple type for identical (name, field-names) pairs,
-    so row-type identity is stable across calls (reference ``unischema.py:88-111``)."""
+    so row-type identity is stable across calls (reference ``unischema.py:88-111``).
+
+    Thread-safe: multiple consumer threads may drain one reader concurrently,
+    and without the lock two first-comers could each build their own class —
+    rows of one schema would then carry different types, breaking the
+    type-identity guarantee."""
 
     _store: Dict[str, Any] = {}
+    _lock = threading.Lock()
 
     @classmethod
     def get(cls, parent_name: str, field_names: Iterable[str]):
         sorted_names = list(sorted(field_names))
         key = ' '.join([parent_name] + sorted_names)
-        if key not in cls._store:
-            cls._store[key] = namedtuple(parent_name, sorted_names)
-        return cls._store[key]
+        with cls._lock:
+            cached = cls._store.get(key)
+            if cached is None:
+                cached = cls._store[key] = namedtuple(parent_name, sorted_names)
+        return cached
 
 
 class Unischema:

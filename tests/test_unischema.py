@@ -126,3 +126,194 @@ def test_from_arrow_schema_inference():
 
     with pytest.raises(ValueError):
         Unischema.from_arrow_schema(arrow_schema, omit_unsupported_fields=False)
+
+
+class TestArrowTypeInference:
+    """from_arrow_schema over the full type map (reference
+    ``unischema.py:467-502`` / tests ``test_unischema.py``), value-level."""
+
+    @pytest.mark.parametrize('arrow_type,expected_dtype', [
+        (pa.int8(), np.int8), (pa.uint8(), np.uint8),
+        (pa.int16(), np.int16), (pa.uint16(), np.uint16),
+        (pa.int32(), np.int32), (pa.uint32(), np.uint32),
+        (pa.int64(), np.int64), (pa.uint64(), np.uint64),
+        (pa.float16(), np.float16), (pa.float32(), np.float32),
+        (pa.float64(), np.float64), (pa.bool_(), np.bool_),
+        (pa.string(), str), (pa.large_string(), str),
+        (pa.binary(), bytes), (pa.large_binary(), bytes),
+        (pa.timestamp('ns'), np.datetime64), (pa.date32(), np.datetime64),
+        (pa.decimal128(10, 2), np.object_),
+    ])
+    def test_scalar_types(self, arrow_type, expected_dtype):
+        schema = Unischema.from_arrow_schema(pa.schema([('x', arrow_type)]))
+        got = schema.fields['x'].numpy_dtype
+        if expected_dtype in (str, bytes):
+            assert got is expected_dtype
+        else:   # numeric dtypes normalize to np.dtype instances
+            assert np.dtype(got) == np.dtype(expected_dtype)
+        assert schema.fields['x'].shape == ()
+
+    @pytest.mark.parametrize('arrow_type,inner', [
+        (pa.list_(pa.int32()), np.int32),
+        (pa.large_list(pa.float64()), np.float64),
+        (pa.list_(pa.string()), str),
+    ])
+    def test_list_types_get_wildcard_shape(self, arrow_type, inner):
+        schema = Unischema.from_arrow_schema(pa.schema([('x', arrow_type)]))
+        got = schema.fields['x'].numpy_dtype
+        if inner in (str, bytes):
+            assert got is inner
+        else:
+            assert np.dtype(got) == np.dtype(inner)
+        assert schema.fields['x'].shape == (None,)
+
+    def test_dictionary_type_resolves_to_value_type(self):
+        t = pa.dictionary(pa.int32(), pa.string())
+        schema = Unischema.from_arrow_schema(pa.schema([('x', t)]))
+        assert schema.fields['x'].numpy_dtype is str
+
+    def test_unsupported_type_omitted_by_default(self):
+        arrow = pa.schema([('ok', pa.int32()),
+                           ('bad', pa.struct([('a', pa.int32())]))])
+        schema = Unischema.from_arrow_schema(arrow)
+        assert set(schema.fields) == {'ok'}
+
+    def test_unsupported_type_raises_when_asked(self):
+        arrow = pa.schema([('bad', pa.struct([('a', pa.int32())]))])
+        with pytest.raises(ValueError, match='Cannot auto-create'):
+            Unischema.from_arrow_schema(arrow, omit_unsupported_fields=False)
+
+    def test_nullability_preserved(self):
+        arrow = pa.schema([pa.field('a', pa.int32(), nullable=False),
+                           pa.field('b', pa.int32(), nullable=True)])
+        schema = Unischema.from_arrow_schema(arrow)
+        assert not schema.fields['a'].nullable
+        assert schema.fields['b'].nullable
+
+
+class TestNamedtupleSemantics:
+    def test_batch_namedtuple_column_access(self):
+        schema = Unischema('B', [
+            UnischemaField('x', np.int64, (), None, False),
+            UnischemaField('y', np.float32, (2,), None, False)])
+        batch = schema.make_batch_namedtuple(
+            x=np.arange(4), y=np.zeros((4, 2), np.float32))
+        np.testing.assert_array_equal(batch.x, np.arange(4))
+        assert batch.y.shape == (4, 2)
+
+    def test_namedtuple_cache_shared_across_equal_views(self):
+        schema = Unischema('C', [
+            UnischemaField('a', np.int64, (), None, False),
+            UnischemaField('b', np.int64, (), None, False)])
+        v1 = schema.create_schema_view(['a'])
+        v2 = schema.create_schema_view(['a'])
+        assert type(v1.make_namedtuple(a=1)) is type(v2.make_namedtuple(a=2))
+
+    def test_make_namedtuple_rejects_missing_fields(self):
+        schema = Unischema('D', [
+            UnischemaField('a', np.int64, (), None, False)])
+        with pytest.raises(TypeError):
+            schema.make_namedtuple()
+
+
+class TestFieldEquality:
+    def test_equal_fields_hash_equal(self):
+        f1 = UnischemaField('m', np.float32, (3,), NdarrayCodec(), False)
+        f2 = UnischemaField('m', np.float32, (3,), NdarrayCodec(), False)
+        assert f1 == f2 and hash(f1) == hash(f2)
+
+    @pytest.mark.parametrize('other', [
+        UnischemaField('m2', np.float32, (3,), None, False),   # name
+        UnischemaField('m', np.float64, (3,), None, False),    # dtype
+        UnischemaField('m', np.float32, (4,), None, False),    # shape
+        UnischemaField('m', np.float32, (3,), None, True),     # nullable
+    ])
+    def test_differing_fields_not_equal(self, other):
+        f = UnischemaField('m', np.float32, (3,), None, False)
+        assert f != other
+
+    def test_json_dict_roundtrip_field(self):
+        f = UnischemaField('img', np.uint8, (None, None, 3),
+                           CompressedImageCodec('jpeg', quality=70), True)
+        back = UnischemaField.from_json_dict(f.to_json_dict())
+        assert back == f
+        assert back.codec.quality == 70
+
+
+class TestEncodeRowEdges:
+    def _schema(self):
+        return Unischema('E', [
+            UnischemaField('id', np.int64, (), ScalarCodec(), False),
+            UnischemaField('vec', np.float32, (3,), NdarrayCodec(), True)])
+
+    def test_missing_nullable_becomes_none(self):
+        encoded = encode_row(self._schema(), {'id': np.int64(1)})
+        assert encoded['vec'] is None
+
+    def test_missing_non_nullable_raises(self):
+        with pytest.raises(ValueError, match='not nullable|not found'):
+            encode_row(self._schema(), {'vec': np.zeros(3, np.float32)})
+
+    def test_explicit_none_for_non_nullable_raises(self):
+        with pytest.raises(ValueError, match='not nullable'):
+            encode_row(self._schema(), {'id': None,
+                                        'vec': np.zeros(3, np.float32)})
+
+    def test_non_dict_row_raises(self):
+        with pytest.raises(TypeError, match='dict'):
+            encode_row(self._schema(), [('id', 1)])
+
+
+class TestRegexViewSemantics:
+    def _schema(self):
+        return Unischema('R', [
+            UnischemaField(n, np.int64, (), None, False)
+            for n in ('id', 'id2', 'id_float', 'sensor_name', 'sensor_id')])
+
+    def test_prefix_does_not_match_without_anchor_tail(self):
+        # fullmatch semantics: 'id' matches only the exact name
+        got = {f.name for f in match_unischema_fields(self._schema(), ['id'])}
+        assert got == {'id'}
+
+    def test_regex_union_across_patterns(self):
+        got = {f.name for f in match_unischema_fields(
+            self._schema(), ['id.*', 'sensor_id'])}
+        assert got == {'id', 'id2', 'id_float', 'sensor_id'}
+
+    def test_empty_pattern_list_matches_nothing(self):
+        assert match_unischema_fields(self._schema(), []) == []
+
+    def test_view_preserves_field_objects(self):
+        schema = self._schema()
+        view = schema.create_schema_view(['sensor.*'])
+        assert set(view.fields) == {'sensor_name', 'sensor_id'}
+        for name in view.fields:
+            assert view.fields[name] is schema.fields[name]
+
+    def test_namedtuple_type_identity_under_concurrency(self):
+        # many threads resolving a COLD cache key must all get one class
+        # (two first-comers building separate classes would give rows of one
+        # schema different types)
+        import threading
+        import uuid
+        name = 'TS_{}'.format(uuid.uuid4().hex[:8])
+        schema = Unischema(name, [
+            UnischemaField('q{}'.format(i), np.int64, (), None, False)
+            for i in range(4)])
+        kwargs = {'q{}'.format(i): i for i in range(4)}
+        types, lock = [], threading.Lock()
+        barrier = threading.Barrier(8)
+
+        def build():
+            view = schema.create_schema_view(['q.*'])   # fresh view per thread
+            barrier.wait()
+            t = type(view.make_namedtuple(**kwargs))
+            with lock:
+                types.append(t)
+
+        threads = [threading.Thread(target=build) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(types)) == 1
