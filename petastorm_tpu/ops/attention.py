@@ -595,8 +595,8 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
     if backend in ('pallas', 'interpret'):
         return _flash(q, k, v, causal, block_q, block_k,
                       backend == 'interpret', bwd or 'pallas')
-    if bwd == 'pallas':
-        raise ValueError("bwd='pallas' needs the Pallas forward (backend "
+    if bwd is not None:
+        raise ValueError("bwd applies only to the Pallas path (backend "
                          "'pallas' or 'interpret'); the %r backend "
                          "differentiates blockwise_attention directly"
                          % backend)

@@ -1,6 +1,7 @@
 """Dataset converter tests (reference ``tests/test_spark_dataset_converter.py``,
 de-Spark-ified)."""
 
+import os
 import pickle
 
 import numpy as np
@@ -138,3 +139,281 @@ class TestRankDetection:
                                        reader_pool_type='dummy',
                                        cur_shard=0, shard_count=2) as loader:
                 list(loader)
+
+    def test_env_var_match_does_not_warn(self, monkeypatch, recwarn):
+        """Matching rank/size args are silently accepted (reference
+        ``test_horovod_rank_compatibility``, the non-warning half)."""
+        monkeypatch.setenv('HOROVOD_RANK', '0')
+        monkeypatch.setenv('HOROVOD_SIZE', '2')
+        saved = make_dataset_converter(_table(2000),
+                                       row_group_size_mb=0.001)
+        with saved.make_jax_loader(batch_size=10, num_epochs=1,
+                                   reader_pool_type='dummy',
+                                   cur_shard=0, shard_count=2) as loader:
+            list(loader)
+        assert not [w for w in recwarn.list
+                    if 'cur_shard' in str(w.message)]
+
+    @pytest.mark.parametrize('envs', [
+        ('OMPI_COMM_WORLD_RANK', 'OMPI_COMM_WORLD_SIZE'),
+        ('PMI_RANK', 'PMI_SIZE'),
+    ])
+    def test_mpi_and_pmi_env_vars(self, monkeypatch, envs):
+        """All three env-var families from the reference are consulted
+        (``spark_dataset_converter.py:124-125``)."""
+        rank_env, size_env = envs
+        monkeypatch.setenv(rank_env, '3')
+        monkeypatch.setenv(size_env, '8')
+        assert conv._get_rank_and_size() == (3, 8)
+
+
+class TestPrimitiveRoundtrip:
+    """Reference ``test_primitive``/``test_dtype``/``test_array``: the full
+    scalar dtype matrix plus list columns survive materialize → read with
+    dtypes preserved."""
+
+    def test_scalar_dtype_matrix(self):
+        n = 64
+        table = pa.table({
+            'f_bool': np.arange(n) % 2 == 0,
+            'f_i8': np.arange(n, dtype=np.int8),
+            'f_i16': np.arange(n, dtype=np.int16),
+            'f_i32': np.arange(n, dtype=np.int32),
+            'f_i64': np.arange(n, dtype=np.int64),
+            'f_f32': np.arange(n, dtype=np.float32) * 0.5,
+            'f_f64': np.arange(n, dtype=np.float64) * 0.25,
+            'f_str': pa.array(['s%d' % i for i in range(n)]),
+        })
+        saved = make_dataset_converter(table)
+        with saved.make_jax_loader(batch_size=n, num_epochs=1,
+                                   reader_pool_type='dummy') as loader:
+            batch = next(iter(loader))
+        # bool→uint8 and string→object are the documented JAX-side
+        # sanitizations; numeric widths must survive exactly.
+        assert batch['f_i8'].dtype == np.int8
+        assert batch['f_i16'].dtype == np.int16
+        assert batch['f_i32'].dtype == np.int32
+        assert batch['f_i64'].dtype == np.int64
+        assert batch['f_f32'].dtype == np.float32
+        assert batch['f_f64'].dtype == np.float64
+        np.testing.assert_array_equal(batch['f_i64'], np.arange(n))
+        np.testing.assert_allclose(batch['f_f32'],
+                                   np.arange(n, dtype=np.float32) * 0.5)
+
+    def test_list_column_roundtrip(self):
+        n = 30
+        values = [list(range(i % 5 + 1)) for i in range(n)]
+        table = pa.table({'id': np.arange(n, dtype=np.int64),
+                          'seq': pa.array(values, pa.list_(pa.int64()))})
+        saved = make_dataset_converter(table)
+        with saved.make_jax_loader(batch_size=n, num_epochs=1,
+                                   reader_pool_type='dummy') as loader:
+            batch = next(iter(loader))
+        got = {int(i): list(s) for i, s in zip(batch['id'], batch['seq'])}
+        assert got == {i: values[i] for i in range(n)}
+
+    def test_precision_float64_upcast(self):
+        table = pa.table({'x': np.arange(10, dtype=np.float32)})
+        saved = make_dataset_converter(table, precision='float64')
+        with saved.make_jax_loader(batch_size=10, num_epochs=1,
+                                   reader_pool_type='dummy') as loader:
+            batch = next(iter(loader))
+        assert batch['x'].dtype == np.float64
+
+    def test_bad_precision_rejected(self):
+        with pytest.raises(ValueError, match='precision'):
+            make_dataset_converter(_table(), precision='float16')
+
+    def test_unsupported_input_type_rejected(self):
+        with pytest.raises(TypeError, match='Unsupported input type'):
+            make_dataset_converter([1, 2, 3])
+
+
+class TestCompression:
+    @pytest.mark.parametrize('compression', [None, 'snappy', 'gzip'])
+    def test_roundtrip(self, compression):
+        """Reference ``test_compression``: default uncompressed, explicit
+        codecs honored; data identical either way."""
+        import pyarrow.parquet as pq
+        saved = make_dataset_converter(_table(), compression=compression)
+        meta = pq.ParquetFile(
+            saved.file_urls[0][len('file://'):]).metadata
+        codec = meta.row_group(0).column(0).compression
+        expect = (compression or 'UNCOMPRESSED').upper()
+        assert codec.upper() == expect
+        with saved.make_jax_loader(batch_size=50, num_epochs=1,
+                                   reader_pool_type='dummy') as loader:
+            ids = [i for b in loader for i in b['id'].tolist()]
+        assert sorted(ids) == list(range(100))
+
+
+class TestCachingSemantics:
+    def test_fingerprint_memoized_by_table_identity(self, monkeypatch):
+        """Repeat conversion of the SAME live arrow table must not re-hash
+        the data (advisor finding: O(data) per call)."""
+        calls = []
+        real = conv._fingerprint
+
+        def counting(table, params):
+            calls.append(1)
+            return real(table, params)
+
+        monkeypatch.setattr(conv, '_fingerprint', counting)
+        table = _table()
+        s1 = make_dataset_converter(table)
+        s2 = make_dataset_converter(table)
+        assert s1 is s2
+        assert len(calls) == 1
+
+    def test_pandas_input_always_rehashed(self, monkeypatch):
+        """Mutable inputs (pandas) must NOT be identity-memoized: an in-place
+        edit between calls has to reach the fingerprint."""
+        df = pd.DataFrame({'id': np.arange(10, dtype=np.int64)})
+        s1 = make_dataset_converter(df)
+        df.loc[5, 'id'] = 99
+        s2 = make_dataset_converter(df)
+        assert s1.cache_dir_url != s2.cache_dir_url
+
+    def test_deleted_cache_rematerializes_from_memo(self):
+        """Memo hit + dead materialization (delete()) re-converts instead of
+        returning a handle to missing files."""
+        table = _table()
+        s1 = make_dataset_converter(table)
+        s1.delete()
+        s2 = make_dataset_converter(table)
+        assert s1.cache_dir_url != s2.cache_dir_url
+        with s2.make_jax_loader(batch_size=50, num_epochs=1,
+                                reader_pool_type='dummy') as loader:
+            assert sum(len(b['id']) for b in loader) == 100
+
+    def test_sliced_tables_do_not_collide(self):
+        """Zero-copy slices share parent buffers; the IPC-stream fingerprint
+        must hash the logical region, not the raw buffers."""
+        base = _table(100)
+        s1 = make_dataset_converter(base.slice(0, 50))
+        s2 = make_dataset_converter(base.slice(50, 50))
+        assert s1.cache_dir_url != s2.cache_dir_url
+
+
+class TestPicklingRemotely:
+    def test_handle_read_in_fresh_interpreter(self, tmp_path):
+        """Reference ``test_pickling_remotely``: the handle crosses a process
+        boundary and opens readers without re-materializing."""
+        import subprocess
+        import sys
+        saved = make_dataset_converter(_table())
+        blob = tmp_path / 'handle.pkl'
+        blob.write_bytes(pickle.dumps(saved))
+        script = (
+            "import pickle, sys\n"
+            "saved = pickle.load(open(sys.argv[1], 'rb'))\n"
+            "with saved.make_jax_loader(batch_size=50, num_epochs=1,\n"
+            "                           reader_pool_type='dummy') as loader:\n"
+            "    total = sum(len(b['id']) for b in loader)\n"
+            "assert total == 100, total\n"
+            "print('REMOTE_OK')\n")
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        out = subprocess.run([sys.executable, '-c', script, str(blob)],
+                             capture_output=True, timeout=300, env=env)
+        assert out.returncode == 0, out.stderr.decode()
+        assert 'REMOTE_OK' in out.stdout.decode()
+
+
+class TestArgPlumbing:
+    def test_reader_kwargs_reach_make_batch_reader(self, monkeypatch):
+        """Reference ``test_tf_dataset_petastorm_args``/
+        ``test_torch_dataloader_advanced_params``: factory kwargs flow through
+        the handle methods into make_batch_reader."""
+        import petastorm_tpu.reader as reader_mod
+        saved = make_dataset_converter(_table(2000), row_group_size_mb=0.001)
+        real = reader_mod.make_batch_reader
+        seen = {}
+
+        def spy(urls, **kwargs):
+            seen.update(kwargs)
+            return real(urls, **kwargs)
+
+        monkeypatch.setattr('petastorm_tpu.reader.make_batch_reader', spy)
+        with saved.make_jax_loader(batch_size=10, num_epochs=1,
+                                   reader_pool_type='dummy',
+                                   cur_shard=1, shard_count=2,
+                                   shuffle_row_groups=False) as loader:
+            list(loader)
+        assert seen['cur_shard'] == 1
+        assert seen['shard_count'] == 2
+        assert seen['num_epochs'] == 1
+        assert seen['shuffle_row_groups'] is False
+
+    def test_transform_spec_through_torch_loader(self):
+        """Reference ``test_torch_transform_spec``."""
+        pytest.importorskip('torch')
+        from petastorm_tpu.transform import TransformSpec
+
+        def double(df):
+            df['value'] = df['value'] * 2
+            return df
+
+        saved = make_dataset_converter(_table())
+        with saved.make_torch_dataloader(
+                batch_size=100, num_epochs=1, reader_pool_type='dummy',
+                transform_spec=TransformSpec(double)) as loader:
+            batch = next(iter(loader))
+        np.testing.assert_allclose(
+            np.sort(np.asarray(batch['value'])),
+            np.arange(100, dtype=np.float64))
+
+    def test_unexpected_param_raises(self):
+        """Reference ``test_torch_unexpected_param``."""
+        saved = make_dataset_converter(_table())
+        with pytest.raises(TypeError):
+            with saved.make_jax_loader(no_such_argument=True) as loader:
+                list(loader)
+
+
+class TestLifecycle:
+    def test_atexit_delete_in_subprocess(self, tmp_path):
+        """Reference ``test_atexit``: delete_at_exit materializations vanish
+        when the owning interpreter exits."""
+        import subprocess
+        import sys
+        cache = tmp_path / 'atexit_cache'
+        script = (
+            "import numpy as np, pyarrow as pa\n"
+            "from petastorm_tpu.converter import make_dataset_converter\n"
+            "saved = make_dataset_converter(\n"
+            "    pa.table({'id': np.arange(10, dtype=np.int64)}),\n"
+            "    parent_cache_dir_url=%r, delete_at_exit=True)\n"
+            "print(saved.cache_dir_url)\n" % str(cache))
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        out = subprocess.run([sys.executable, '-c', script],
+                             capture_output=True, timeout=300, env=env)
+        assert out.returncode == 0, out.stderr.decode()
+        url = out.stdout.decode().strip().splitlines()[-1]
+        path = url[len('file://'):] if url.startswith('file://') else url
+        assert path.startswith(str(cache))  # guard against vacuous pass
+        assert not os.path.exists(path), 'atexit did not delete %s' % path
+
+    def test_no_cache_dir_configured_raises(self, monkeypatch):
+        set_parent_cache_dir_url(None)
+        monkeypatch.delenv('PETASTORM_TPU_CACHE_DIR', raising=False)
+        with pytest.raises(ValueError, match='No cache directory'):
+            make_dataset_converter(_table())
+
+    def test_env_var_cache_dir(self, tmp_path, monkeypatch):
+        set_parent_cache_dir_url(None)
+        monkeypatch.setenv('PETASTORM_TPU_CACHE_DIR',
+                           'file://' + str(tmp_path / 'env_cache'))
+        saved = make_dataset_converter(_table())
+        assert str(tmp_path / 'env_cache') in saved.cache_dir_url
+
+    def test_wait_file_available_success_and_timeout(self, tmp_path):
+        """Reference ``test_wait_file_available``: polls until present;
+        times out with the missing paths in the error."""
+        import fsspec
+        fs = fsspec.filesystem('file')
+        present = tmp_path / 'present.bin'
+        present.write_bytes(b'x')
+        conv._wait_file_available(fs, [str(present)], timeout_s=1.0)
+        with pytest.raises(RuntimeError, match='Timed out'):
+            conv._wait_file_available(fs, [str(tmp_path / 'never.bin')],
+                                      timeout_s=0.3)

@@ -1,0 +1,177 @@
+"""WeightedSamplingReader unit + integration suite.
+
+Reference parity: ``petastorm/tests/test_weighted_sampling_reader.py`` —
+select-one, non-normalized probabilities, statistical mixing, real readers,
+bad arguments, schema/ngram compatibility, and framework-adapter integration.
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.test_util.reader_mock import ReaderMock
+from petastorm_tpu.unischema import Unischema, UnischemaField
+from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+_SCHEMA = Unischema('mock', [
+    UnischemaField('id', np.int64, (), None, False),
+])
+
+
+class _StubReader:
+    """Infinite reader yielding a constant tag — lets tests count exactly
+    which underlying reader served each row."""
+
+    def __init__(self, tag, schema=_SCHEMA, batched_output=False, ngram=None):
+        self.tag = tag
+        self.schema = schema
+        self.batched_output = batched_output
+        self.ngram = ngram
+        self.last_row_consumed = False
+        self.stopped = False
+        self.joined = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.tag
+
+    def stop(self):
+        self.stopped = True
+
+    def join(self):
+        self.joined = True
+
+
+class TestSelection:
+    def test_select_only_one_of_readers(self):
+        mixed = WeightedSamplingReader(
+            [_StubReader('a'), _StubReader('b')], [0.0, 1.0], seed=0)
+        assert [next(mixed) for _ in range(100)] == ['b'] * 100
+
+    def test_not_normalized_probabilities(self):
+        """[2, 6] must behave exactly like [0.25, 0.75]."""
+        counts = collections.Counter()
+        mixed = WeightedSamplingReader(
+            [_StubReader('a'), _StubReader('b')], [2, 6], seed=7)
+        for _ in range(4000):
+            counts[next(mixed)] += 1
+        assert abs(counts['b'] / 4000 - 0.75) < 0.05
+
+    def test_mixing_ratios(self):
+        counts = collections.Counter()
+        mixed = WeightedSamplingReader(
+            [_StubReader(t) for t in 'abc'], [0.5, 0.3, 0.2], seed=3)
+        for _ in range(6000):
+            counts[next(mixed)] += 1
+        assert abs(counts['a'] / 6000 - 0.5) < 0.05
+        assert abs(counts['b'] / 6000 - 0.3) < 0.05
+        assert abs(counts['c'] / 6000 - 0.2) < 0.05
+
+    def test_seed_reproducible(self):
+        def stream(seed):
+            mixed = WeightedSamplingReader(
+                [_StubReader('a'), _StubReader('b')], [0.5, 0.5], seed=seed)
+            return [next(mixed) for _ in range(200)]
+
+        assert stream(11) == stream(11)
+        assert stream(11) != stream(12)
+
+    def test_stops_when_any_reader_exhausted(self):
+        finite = ReaderMock(_SCHEMA, num_rows=5)
+        mixed = WeightedSamplingReader(
+            [finite, _StubReader('b')], [1.0, 0.0], seed=0)
+        rows = list(mixed)
+        assert len(rows) == 5
+        assert mixed.last_row_consumed
+
+
+class TestValidation:
+    def test_bad_arguments(self):
+        with pytest.raises(ValueError, match='equal length'):
+            WeightedSamplingReader([_StubReader('a')], [0.5, 0.5])
+        with pytest.raises(ValueError, match='At least one'):
+            WeightedSamplingReader([], [])
+        with pytest.raises(ValueError, match='positive'):
+            WeightedSamplingReader([_StubReader('a')], [0.0])
+        with pytest.raises(ValueError, match='positive'):
+            WeightedSamplingReader([_StubReader('a'), _StubReader('b')],
+                                   [-1.0, 1.0])
+
+    def test_schema_mismatch(self):
+        other_schema = Unischema('other', [
+            UnischemaField('other_field', np.int64, (), None, False),
+        ])
+        with pytest.raises(ValueError, match='same schema'):
+            WeightedSamplingReader(
+                [_StubReader('a'), _StubReader('b', schema=other_schema)],
+                [0.5, 0.5])
+
+    def test_batched_output_mismatch(self):
+        with pytest.raises(ValueError, match='batched_output'):
+            WeightedSamplingReader(
+                [_StubReader('a'), _StubReader('b', batched_output=True)],
+                [0.5, 0.5])
+
+    def test_ngram_mismatch(self):
+        with pytest.raises(ValueError, match='ngram'):
+            WeightedSamplingReader(
+                [_StubReader('a', ngram=object()), _StubReader('b')],
+                [0.5, 0.5])
+
+    def test_ngram_pair_allowed(self):
+        mixed = WeightedSamplingReader(
+            [_StubReader('a', ngram=object()),
+             _StubReader('b', ngram=object())], [0.5, 0.5], seed=0)
+        assert mixed.ngram is not None
+
+    def test_context_manager_stops_all(self):
+        readers = [_StubReader('a'), _StubReader('b')]
+        with WeightedSamplingReader(readers, [0.5, 0.5], seed=0) as mixed:
+            next(mixed)
+        assert all(r.stopped and r.joined for r in readers)
+
+
+class TestRealReaders:
+    def test_mix_two_real_readers(self, synthetic_dataset):
+        """Reference ``test_real_reader``: two live readers over the same
+        store mix without losing schema-compliance of the rows."""
+        r1 = make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         schema_fields=['^id$'], num_epochs=None,
+                         shuffle_row_groups=False)
+        r2 = make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         schema_fields=['^id$'], num_epochs=None,
+                         shuffle_row_groups=False)
+        expected_ids = {d['id'] for d in synthetic_dataset.data}
+        with WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=0) as mixed:
+            got = [mixed.next().id for _ in range(50)]
+        assert set(got) <= expected_ids
+        assert len(got) == 50
+
+    def test_mix_through_torch_loader(self):
+        """Reference ``test_with_torch_api``: the mixed reader feeds the
+        row-granular DataLoader."""
+        torch = pytest.importorskip('torch')
+        from petastorm_tpu.pytorch import DataLoader
+        readers = [ReaderMock(_SCHEMA, num_rows=40),
+                   ReaderMock(_SCHEMA, num_rows=40)]
+        mixed = WeightedSamplingReader(readers, [0.5, 0.5], seed=0)
+        with DataLoader(mixed, batch_size=10) as loader:
+            batches = list(loader)
+        assert batches, 'mixed reader produced no batches'
+        assert all(isinstance(b['id'], torch.Tensor) for b in batches)
+        assert all(len(b['id']) == 10 for b in batches[:-1])
+
+    def test_mix_through_jax_loader(self):
+        """The JAX per-row loader accepts the mixed reader surface too."""
+        from petastorm_tpu.jax_utils import JaxDataLoader
+        readers = [ReaderMock(_SCHEMA, num_rows=30),
+                   ReaderMock(_SCHEMA, num_rows=30)]
+        mixed = WeightedSamplingReader(readers, [0.5, 0.5], seed=0)
+        with JaxDataLoader(mixed, batch_size=10) as loader:
+            batches = list(loader)
+        assert batches
+        assert all(b['id'].shape[0] == 10 for b in batches[:-1])
