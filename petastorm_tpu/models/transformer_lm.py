@@ -182,11 +182,15 @@ def _ring_attention_sharded(q, k, v, mesh):
     spec = P('data' if 'data' in names else None,
              'model' if 'model' in names else None,
              'seq', None)
+    # Resolve from the mesh devices (not the session default backend): TPU
+    # meshes get per-chunk Pallas kernels, CPU meshes the jnp path.
+    from petastorm_tpu.parallel.ring import resolve_ring_impl
+    impl = resolve_ring_impl(None, mesh)
 
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=(spec, spec, spec), out_specs=spec)
     def fn(q, k, v):
-        return ring_attention(q, k, v, 'seq', causal=True)
+        return ring_attention(q, k, v, 'seq', causal=True, impl=impl)
 
     return fn(q, k, v)
 

@@ -216,6 +216,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *refs, block_q: int,
             lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
+def _out_vma(*arrays):
+    """Union of the inputs' varying-mesh-axes sets, for pallas_call out_shapes
+    under ``shard_map`` (its vma check requires outputs to declare how they
+    vary across mesh axes; kernel outputs vary exactly over the axes the
+    operands do). Returns None on jax versions without vma tracking."""
+    try:
+        sets = [frozenset(jax.typeof(a).vma) for a in arrays]
+    except (AttributeError, TypeError):
+        return None
+    return frozenset().union(*sets)
+
+
+def _sds(shape, dtype, vma):
+    if not vma:   # outside shard_map (None) or fully replicated (empty)
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 class _FlashDims:
     """Shared clamp/pad/flatten preamble of the forward and backward Pallas
     calls — ONE definition of the block-clamping and padding policy, so the
@@ -288,11 +306,12 @@ def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(
         _flash_kernel, block_q=bq, block_k=bk, causal=causal, scale=scale,
         kv_seq_len=kv_len, num_kv_blocks=num_kv_blocks, with_lse=with_lse)
+    vma = _out_vma(q, k, v)
     out_specs = [pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0))]
-    out_shape = [jax.ShapeDtypeStruct((flat, pq_len, head_dim), q.dtype)]
+    out_shape = [_sds((flat, pq_len, head_dim), q.dtype, vma)]
     if with_lse:
         out_specs.append(pl.BlockSpec((None, bq, 128), lambda b, i, j: (b, i, 0)))
-        out_shape.append(jax.ShapeDtypeStruct((flat, pq_len, 128), jnp.float32))
+        out_shape.append(_sds((flat, pq_len, 128), jnp.float32, vma))
     result = pl.pallas_call(
         kernel,
         grid=(flat, pq_len // bq, num_kv_blocks),
@@ -476,6 +495,16 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _prepare_flash_bwd_q_side(dims: '_FlashDims', q, o, lse, do):
+    """The q-side backward operands (padded q/do and the per-row lse/Δ
+    columns) — step-invariant in ring attention, so callers scanning over kv
+    chunks hoist this out of the loop instead of re-padding and re-reducing
+    Δ = rowsum(do·o) per chunk."""
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    return (dims.pad_q_like(q), dims.pad_q_like(do), dims.pad_rows(lse),
+            dims.pad_rows(delta))
+
+
 def _pallas_flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
                            block_k: int, interpret: bool = False):
     """Fused flash backward: two Pallas kernels (dq; dk/dv), both streaming
@@ -485,24 +514,27 @@ def _pallas_flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
     lse/Δ ride as ``(flat, L, 1)`` arrays with ``(bq, 1)`` blocks — the lane
     dim of the block equals the full array dim, which Mosaic lowers without
     the 128-lane replication the forward's lse *output* needs."""
+    dims = _FlashDims(q.shape, k.shape[-2], block_q, block_k)
+    prep = _prepare_flash_bwd_q_side(dims, q, o, lse, do)
+    return _flash_backward_from_prepared(dims, prep, k, v, causal=causal,
+                                         interpret=interpret)
+
+
+def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
+                                  causal: bool, interpret: bool = False):
+    """Backward kernels given pre-padded q-side operands (see
+    :func:`_prepare_flash_bwd_q_side`); only the kv chunk varies per call."""
     from jax.experimental import pallas as pl
     import jax.experimental.pallas.tpu as pltpu
 
-    dims = _FlashDims(q.shape, k.shape[-2], block_q, block_k)
+    qf, dof, lsef, deltaf = prep
     kv_len, head_dim, bq, bk = dims.kv_len, dims.head_dim, dims.bq, dims.bk
     flat, pq_len, pk_len = dims.flat, dims.pq_len, dims.pk_len
     num_q_blocks, num_kv_blocks = dims.num_q_blocks, dims.num_kv_blocks
     scale = dims.scale
-
-    # Δ_i = rowsum(do_i · o_i) — computed on unpadded inputs, f32.
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-
-    qf = dims.pad_q_like(q)
     kf = dims.pad_kv_like(k)
     vf = dims.pad_kv_like(v)
-    dof = dims.pad_q_like(do)
-    lsef = dims.pad_rows(lse)
-    deltaf = dims.pad_rows(delta)
+    vma = _out_vma(qf, k, v, dof)
 
     qspec = pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0))
     kvspec_j = pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, j, 0))
@@ -514,7 +546,7 @@ def _pallas_flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
         grid=(flat, num_q_blocks, num_kv_blocks),
         in_specs=[qspec, kvspec_j, kvspec_j, qspec, rowspec_i, rowspec_i],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((flat, pq_len, head_dim), q.dtype),
+        out_shape=_sds((flat, pq_len, head_dim), qf.dtype, vma),
         scratch_shapes=[pltpu.VMEM((bq, head_dim), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
@@ -531,8 +563,8 @@ def _pallas_flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
         grid=(flat, num_kv_blocks, num_q_blocks),
         in_specs=[qspec_j, kvspec_i, kvspec_i, qspec_j, rowspec_j, rowspec_j],
         out_specs=[kvspec_i, kvspec_i],
-        out_shape=[jax.ShapeDtypeStruct((flat, pk_len, head_dim), k.dtype),
-                   jax.ShapeDtypeStruct((flat, pk_len, head_dim), v.dtype)],
+        out_shape=[_sds((flat, pk_len, head_dim), k.dtype, vma),
+                   _sds((flat, pk_len, head_dim), v.dtype, vma)],
         scratch_shapes=[pltpu.VMEM((bk, head_dim), jnp.float32),
                         pltpu.VMEM((bk, head_dim), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -541,6 +573,47 @@ def _pallas_flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
     )(qf, kf, vf, dof, lsef, deltaf)
 
     return dims.unpad_q_like(dq), dims.unpad_kv_like(dk), dims.unpad_kv_like(dv)
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = True,
+                             block_q: int = 256, block_k: int = 512,
+                             interpret: bool = False):
+    """Pallas flash forward returning ``(o, lse)`` — the building block of
+    ring attention's per-chunk computation: each visiting kv chunk is attended
+    by the fused kernel, and the normalized per-chunk outputs are folded
+    together with :func:`merge_attention_chunks`. Not differentiable on its
+    own (ring attention wraps the whole chunk loop in a custom_vjp)."""
+    return _pallas_flash(q, k, v, causal, block_q, block_k, interpret,
+                         with_lse=True)
+
+
+def flash_attention_chunk_grads(q, k, v, o, lse, do, *, causal: bool,
+                                block_q: int = 256, block_k: int = 512,
+                                interpret: bool = False):
+    """Per-chunk-pair gradients via the fused backward kernels: given local
+    queries (with their GLOBAL output o and logsumexp lse) against one kv
+    chunk, returns (dq, dk, dv) for exactly that pair — p = exp(s − lse)
+    already yields global softmax probabilities, so cross-chunk gradients
+    need no further normalization."""
+    return _pallas_flash_backward(q, k, v, o, lse, do, causal=causal,
+                                  block_q=block_q, block_k=block_k,
+                                  interpret=interpret)
+
+
+def merge_attention_chunks(o_acc, m, l, o_i, lse_i):
+    """Fold one finished attention chunk — normalized output ``o_i`` plus its
+    per-row logsumexp ``lse_i`` — into running accumulators ``(o_acc, m, l)``.
+
+    Contract: ``o_acc = Σ_j o_j·exp(lse_j − m)`` and ``l = Σ_j exp(lse_j − m)``
+    with ``m = max_j lse_j``, so ``o_acc / l`` is the softmax-weighted merge
+    (:func:`finalize_attention`) and ``m + log(l)`` the merged logsumexp.
+    Fully-masked chunks carry ``lse_i == -inf`` and contribute weight 0; the
+    exponents are never positive, so nothing overflows."""
+    m_new = jnp.maximum(m, lse_i)
+    corr = jnp.exp(m - m_new)
+    w = jnp.exp(lse_i - m_new)
+    o_acc = o_acc * corr[..., None] + o_i.astype(jnp.float32) * w[..., None]
+    return o_acc, m_new, l * corr + w
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
