@@ -172,12 +172,15 @@ class TestMoeDispatch:
                         jnp.float32)
         return layer, x
 
-    def test_sparse_matches_dense_oracle_with_ample_capacity(self, cpus):
-        # capacity_factor = n_experts → capacity = n tokens: nothing can be
-        # dropped, so sort/scatter dispatch must reproduce the dense one-hot
-        # oracle exactly
+    @pytest.mark.parametrize('top_k', [1, 2])
+    def test_sparse_matches_dense_oracle_with_ample_capacity(self, cpus,
+                                                             top_k):
+        # capacity_factor = n_experts → capacity = all dispatch units:
+        # nothing can be dropped, so sort/scatter dispatch must reproduce the
+        # dense one-hot oracle exactly (k=1 Switch and k=2 GShard routing)
         from petastorm_tpu.models import transformer_lm as tlm
-        cfg = _tiny_config(n_experts=4, moe_capacity_factor=4.0)
+        cfg = _tiny_config(n_experts=4, moe_capacity_factor=4.0,
+                           moe_top_k=top_k)
         layer, x = self._layer_and_x(cfg)
         with jax.default_device(cpus[0]):
             sparse, aux = tlm._moe_ffn(x, layer, cfg)
@@ -186,6 +189,29 @@ class TestMoeDispatch:
                                    atol=1e-5)
         # Switch aux loss is minimized at 1.0 for perfectly uniform routing
         assert float(aux) >= 1.0 - 1e-5
+
+    def test_top2_scales_normalized_and_token_uses_two_experts(self, cpus):
+        """k=2: a token's two expert outputs are combined with weights that
+        sum to 1; with only 2 experts and ample capacity nothing is dropped,
+        so the result equals the full softmax-weighted two-expert mix."""
+        from petastorm_tpu.models import transformer_lm as tlm
+        cfg = _tiny_config(n_experts=2, moe_capacity_factor=2.0, moe_top_k=2)
+        layer, x = self._layer_and_x(cfg)
+        with jax.default_device(cpus[0]):
+            sparse, _ = tlm._moe_ffn(x, layer, cfg)
+            # with E == k == 2 every token uses both experts, weights =
+            # softmax probs renormalized over both = the probs themselves
+            logits = x.astype(jnp.float32) @ layer['gate']
+            probs = jax.nn.softmax(logits, axis=-1)
+            outs = []
+            for e_i in range(2):
+                gate = jax.nn.silu(x @ layer['w_gate'][e_i].astype(x.dtype))
+                up = x @ layer['w_up'][e_i].astype(x.dtype)
+                outs.append((gate * up) @ layer['w_down'][e_i].astype(x.dtype))
+            ref = (outs[0] * probs[..., 0:1].astype(x.dtype)
+                   + outs[1] * probs[..., 1:2].astype(x.dtype))
+        np.testing.assert_allclose(np.asarray(sparse), np.asarray(ref),
+                                   atol=1e-5)
 
     def test_over_capacity_tokens_pass_through_as_zeros(self, cpus):
         from petastorm_tpu.models import transformer_lm as tlm
@@ -212,11 +238,12 @@ class TestMoeDispatch:
         f2, f8 = moe_flops(2), moe_flops(8)
         assert f8 < f2 * 1.5, (f2, f8)   # dense dispatch would give ~4x
 
-    def test_grad_flows_and_sharded_step_runs(self, cpus):
+    @pytest.mark.parametrize('top_k', [1, 2])
+    def test_grad_flows_and_sharded_step_runs(self, cpus, top_k):
         from jax.sharding import NamedSharding, PartitionSpec
         from petastorm_tpu.models import transformer_lm as tlm
         from petastorm_tpu.parallel import make_mesh
-        cfg = _tiny_config(n_experts=4)
+        cfg = _tiny_config(n_experts=4, moe_top_k=top_k)
         mesh = make_mesh({'data': 2, 'expert': 4}, devices=cpus[:8])
         params = tlm.init(jax.random.PRNGKey(0), cfg)
         pspecs = tlm.param_specs(cfg, mesh)
