@@ -38,6 +38,10 @@ class TransformerConfig:
     vocab_size: int = 32000
     d_model: int = 512
     n_heads: int = 8
+    # kv heads for grouped-query attention; None = n_heads (MHA). The
+    # 'flash' path reads shared kv natively (no repeated kv in HBM);
+    # 'blockwise'/'ring' repeat kv heads explicitly.
+    n_kv_heads: Optional[int] = None
     n_layers: int = 4
     d_ff: int = 2048
     max_seq_len: int = 2048
@@ -62,6 +66,10 @@ class TransformerConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
 
 # ---------------------------------------------------------------------------
 # init
@@ -80,13 +88,20 @@ def init(rng, config: TransformerConfig) -> Dict:
         'unembed': dense(keys[1], c.d_model, (c.d_model, c.vocab_size)),
         'layers': [],
     }
+    if c.n_heads % c.kv_heads != 0:
+        raise ValueError('n_heads (%d) must be a multiple of n_kv_heads (%d)'
+                         % (c.n_heads, c.kv_heads))
+    if c.n_experts > 0 and not 1 <= c.moe_top_k <= c.n_experts:
+        raise ValueError('moe_top_k (%d) must be in [1, n_experts=%d]'
+                         % (c.moe_top_k, c.n_experts))
+    kv_dim = c.kv_heads * c.head_dim
     for i in range(c.n_layers):
         lk = jax.random.split(keys[2 + i], 8)
         layer = {
             'ln1': jnp.ones((c.d_model,), jnp.float32),
             'wq': dense(lk[0], c.d_model, (c.d_model, c.d_model)),
-            'wk': dense(lk[1], c.d_model, (c.d_model, c.d_model)),
-            'wv': dense(lk[2], c.d_model, (c.d_model, c.d_model)),
+            'wk': dense(lk[1], c.d_model, (c.d_model, kv_dim)),
+            'wv': dense(lk[2], c.d_model, (c.d_model, kv_dim)),
             'wo': dense(lk[3], c.d_model, (c.d_model, c.d_model)),
             'ln2': jnp.ones((c.d_model,), jnp.float32),
         }
@@ -203,14 +218,20 @@ def _ring_attention_sharded(q, k, v, mesh):
 def _attention(x, layer, config: TransformerConfig, positions, mesh=None):
     c = config
     b, l, _ = x.shape
-    h, dh = c.n_heads, c.head_dim
+    h, hkv, dh = c.n_heads, c.kv_heads, c.head_dim
 
-    def heads(w):
-        y = (x @ w.astype(x.dtype)).reshape(b, l, h, dh)
-        return jnp.transpose(y, (0, 2, 1, 3))        # (B, H, L, dh)
+    def heads(w, n):
+        y = (x @ w.astype(x.dtype)).reshape(b, l, n, dh)
+        return jnp.transpose(y, (0, 2, 1, 3))        # (B, n, L, dh)
 
-    q, k, v = heads(layer['wq']), heads(layer['wk']), heads(layer['wv'])
+    q = heads(layer['wq'], h)
+    k = heads(layer['wk'], hkv)
+    v = heads(layer['wv'], hkv)
     q, k = _rope(q, positions), _rope(k, positions)
+    if hkv != h and c.attention != 'flash':
+        # flash reads shared kv natively; the other paths repeat heads
+        k = jnp.repeat(k, h // hkv, axis=1)
+        v = jnp.repeat(v, h // hkv, axis=1)
 
     if c.attention == 'ring':
         if mesh is None or 'seq' not in mesh.axis_names:

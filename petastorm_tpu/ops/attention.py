@@ -244,11 +244,35 @@ class _FlashDims:
     multiple and flatten batch dims to ``(flat, L, D)``; ``pad_rows`` does
     the same for per-q-row vectors ``(..., Lq)`` → ``(flat, Lq, 1)``
     (zero pad: backward padded rows have q == do == 0, so p = exp(0 − 0)
-    stays finite and every contribution vanishes)."""
+    stays finite and every contribution vanishes).
 
-    def __init__(self, q_shape, kv_len: int, block_q: int, block_k: int):
+    Grouped-query attention: when kv carries fewer heads than q (shapes
+    equal except axis -3, q heads a multiple of kv heads), ``group`` > 1 and
+    ``kv_program_index`` maps a q program to the kv row its head shares —
+    the kernels read shared kv blocks directly instead of materializing
+    ``jnp.repeat``-ed kv in HBM."""
+
+    def __init__(self, q_shape, kv_shape, block_q: int, block_k: int):
         *batch, q_len, head_dim = q_shape
+        *kv_batch, kv_len, kv_head_dim = kv_shape
         self.batch = tuple(batch)
+        self.kv_batch = tuple(kv_batch)
+        if self.batch == self.kv_batch:
+            self.group = 1
+        else:
+            if (kv_head_dim != head_dim
+                    or len(self.batch) != len(self.kv_batch)
+                    or not self.batch
+                    or self.batch[:-1] != self.kv_batch[:-1]
+                    or self.kv_batch[-1] <= 0
+                    or self.batch[-1] % self.kv_batch[-1] != 0):
+                raise ValueError(
+                    'q/kv batch dims must match, or differ only in the head '
+                    'axis (-3) with q heads a multiple of kv heads (GQA); '
+                    'got q %r vs kv %r' % (q_shape, kv_shape))
+            self.group = self.batch[-1] // self.kv_batch[-1]
+        self.n_q_heads = self.batch[-1] if self.batch else 1
+        self.n_kv_heads = self.kv_batch[-1] if self.kv_batch else 1
         self.q_len, self.kv_len, self.head_dim = q_len, kv_len, head_dim
         self.bq = min(block_q, q_len)
         self.bk = min(block_k, kv_len)
@@ -256,20 +280,28 @@ class _FlashDims:
         self.pad_k = (-kv_len) % self.bk
         self.pq_len, self.pk_len = q_len + self.pad_q, kv_len + self.pad_k
         self.flat = int(math.prod(batch)) if batch else 1
+        self.kv_flat = int(math.prod(kv_batch)) if kv_batch else 1
         self.num_q_blocks = self.pq_len // self.bq
         self.num_kv_blocks = self.pk_len // self.bk
         self.scale = 1.0 / math.sqrt(head_dim)
 
-    def _pad_flatten(self, x, pad, plen):
+    def kv_program_index(self):
+        """flat q-program index → flat kv row index (identity unless GQA)."""
+        if self.group == 1:
+            return lambda b: b
+        h, hkv, g = self.n_q_heads, self.n_kv_heads, self.group
+        return lambda b: (b // h) * hkv + (b % h) // g
+
+    def _pad_flatten(self, x, pad, plen, flat):
         if pad:
             x = jnp.pad(x, [(0, 0)] * (x.ndim - 2) + [(0, pad), (0, 0)])
-        return x.reshape(self.flat, plen, self.head_dim)
+        return x.reshape(flat, plen, self.head_dim)
 
     def pad_q_like(self, x):
-        return self._pad_flatten(x, self.pad_q, self.pq_len)
+        return self._pad_flatten(x, self.pad_q, self.pq_len, self.flat)
 
     def pad_kv_like(self, x):
-        return self._pad_flatten(x, self.pad_k, self.pk_len)
+        return self._pad_flatten(x, self.pad_k, self.pk_len, self.kv_flat)
 
     def pad_rows(self, x):
         if self.pad_q:
@@ -282,7 +314,19 @@ class _FlashDims:
 
     def unpad_kv_like(self, x):
         return x[:, :self.kv_len, :].reshape(
-            self.batch + (self.kv_len, self.head_dim))
+            self.kv_batch + (self.kv_len, self.head_dim))
+
+    def sum_head_groups(self, x):
+        """Per-q-head kv gradients ``(flat, L, D)`` → per-kv-head
+        ``(kv_flat, L, D)`` by summing each head group (identity when not
+        GQA). Inputs should be float32 — the group sum happens before any
+        cast back to the storage dtype."""
+        if self.group == 1:
+            return x
+        b = self.flat // self.n_q_heads
+        return x.reshape(b, self.n_kv_heads, self.group,
+                         *x.shape[1:]).sum(axis=2).reshape(
+                             self.kv_flat, *x.shape[1:])
 
 
 def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -294,11 +338,12 @@ def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
     from jax.experimental import pallas as pl
     import jax.experimental.pallas.tpu as pltpu
 
-    dims = _FlashDims(q.shape, k.shape[-2], block_q, block_k)
+    dims = _FlashDims(q.shape, k.shape, block_q, block_k)
     batch, q_len, head_dim = dims.batch, dims.q_len, dims.head_dim
     kv_len, bq, bk, flat = dims.kv_len, dims.bq, dims.bk, dims.flat
     pq_len, num_kv_blocks = dims.pq_len, dims.num_kv_blocks
     scale = dims.scale
+    kvmap = dims.kv_program_index()
     qf = dims.pad_q_like(q)
     kf = dims.pad_kv_like(k)
     vf = dims.pad_kv_like(v)
@@ -317,8 +362,10 @@ def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
         grid=(flat, pq_len // bq, num_kv_blocks),
         in_specs=[
             pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, bk, head_dim),
+                         lambda b, i, j: (kvmap(b), j, 0)),
+            pl.BlockSpec((None, bk, head_dim),
+                         lambda b, i, j: (kvmap(b), j, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -514,7 +561,7 @@ def _pallas_flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
     lse/Δ ride as ``(flat, L, 1)`` arrays with ``(bq, 1)`` blocks — the lane
     dim of the block equals the full array dim, which Mosaic lowers without
     the 128-lane replication the forward's lse *output* needs."""
-    dims = _FlashDims(q.shape, k.shape[-2], block_q, block_k)
+    dims = _FlashDims(q.shape, k.shape, block_q, block_k)
     prep = _prepare_flash_bwd_q_side(dims, q, o, lse, do)
     return _flash_backward_from_prepared(dims, prep, k, v, causal=causal,
                                          interpret=interpret)
@@ -523,7 +570,12 @@ def _pallas_flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
 def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
                                   causal: bool, interpret: bool = False):
     """Backward kernels given pre-padded q-side operands (see
-    :func:`_prepare_flash_bwd_q_side`); only the kv chunk varies per call."""
+    :func:`_prepare_flash_bwd_q_side`); only the kv chunk varies per call.
+
+    GQA: the dk/dv kernel runs one program per Q head (reading the shared kv
+    row via the head map) and emits per-q-head float32 partials that are
+    group-summed outside — a transient ``group``× float32 buffer, traded for
+    never materializing repeated kv."""
     from jax.experimental import pallas as pl
     import jax.experimental.pallas.tpu as pltpu
 
@@ -532,12 +584,14 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
     flat, pq_len, pk_len = dims.flat, dims.pq_len, dims.pk_len
     num_q_blocks, num_kv_blocks = dims.num_q_blocks, dims.num_kv_blocks
     scale = dims.scale
+    kvmap = dims.kv_program_index()
     kf = dims.pad_kv_like(k)
     vf = dims.pad_kv_like(v)
     vma = _out_vma(qf, k, v, dof)
 
     qspec = pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0))
-    kvspec_j = pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, j, 0))
+    kvspec_j = pl.BlockSpec((None, bk, head_dim),
+                            lambda b, i, j: (kvmap(b), j, 0))
     rowspec_i = pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=bq, block_k=bk,
@@ -554,17 +608,21 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
     )(qf, kf, vf, dof, lsef, deltaf)
 
     qspec_j = pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, j, 0))
-    kvspec_i = pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, i, 0))
+    kvspec_i = pl.BlockSpec((None, bk, head_dim),
+                            lambda b, i, j: (kvmap(b), i, 0))
+    outspec_i = pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, i, 0))
     rowspec_j = pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, j, 0))
+    # per-Q-head float32 partials: exact for group == 1 too (the f32→storage
+    # cast just moves from the kernel's final write to after the group sum)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, block_q=bq, block_k=bk,
                           causal=causal, scale=scale, kv_seq_len=kv_len,
                           num_q_blocks=num_q_blocks),
         grid=(flat, num_kv_blocks, num_q_blocks),
         in_specs=[qspec_j, kvspec_i, kvspec_i, qspec_j, rowspec_j, rowspec_j],
-        out_specs=[kvspec_i, kvspec_i],
-        out_shape=[_sds((flat, pk_len, head_dim), k.dtype, vma),
-                   _sds((flat, pk_len, head_dim), v.dtype, vma)],
+        out_specs=[outspec_i, outspec_i],
+        out_shape=[_sds((flat, pk_len, head_dim), jnp.float32, vma),
+                   _sds((flat, pk_len, head_dim), jnp.float32, vma)],
         scratch_shapes=[pltpu.VMEM((bk, head_dim), jnp.float32),
                         pltpu.VMEM((bk, head_dim), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -572,6 +630,8 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, deltaf)
 
+    dk = dims.sum_head_groups(dk).astype(k.dtype)
+    dv = dims.sum_head_groups(dv).astype(v.dtype)
     return dims.unpad_q_like(dq), dims.unpad_kv_like(dk), dims.unpad_kv_like(dv)
 
 
@@ -634,6 +694,16 @@ def _flash_bwd(causal, block_q, block_k, interpret, bwd_backend, res, do):
         return _pallas_flash_backward(q, k, v, o, lse, do, causal=causal,
                                       block_q=block_q, block_k=block_k,
                                       interpret=interpret)
+    if q.shape[:-2] != k.shape[:-2]:     # GQA through the jnp oracle:
+        group = q.shape[-3] // k.shape[-3]
+        kr = jnp.repeat(k, group, axis=-3)
+        vr = jnp.repeat(v, group, axis=-3)
+        dq, dkr, dvr = _flash_backward(q, kr, vr, o, lse, do, causal=causal,
+                                       block_k=block_k)
+        shape = k.shape[:-3] + (k.shape[-3], group) + k.shape[-2:]
+        dk = dkr.astype(jnp.float32).reshape(shape).sum(axis=-3)
+        dv = dvr.astype(jnp.float32).reshape(shape).sum(axis=-3)
+        return dq, dk.astype(k.dtype), dv.astype(v.dtype)
     return _flash_backward(q, k, v, o, lse, do, causal=causal, block_k=block_k)
 
 
@@ -646,6 +716,11 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
     """Fused attention over ``(..., L, D)`` inputs; differentiable (custom_vjp
     with fused Pallas backward kernels), any sequence length (padded to block
     multiples internally).
+
+    Grouped-query attention: k/v may carry fewer heads than q (shapes equal
+    except axis -3, q heads a multiple of kv heads). The Pallas path reads
+    shared kv blocks via the head map — repeated kv is never materialized in
+    HBM; the jnp fallback repeats kv explicitly.
 
     ``backend``: 'pallas' forces the TPU kernel, 'jnp' the scan fallback,
     'interpret' the Pallas interpreter (CI on CPU); default picks Pallas on TPU.
@@ -673,4 +748,9 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
                          "'pallas' or 'interpret'); the %r backend "
                          "differentiates blockwise_attention directly"
                          % backend)
+    if q.shape[:-2] != k.shape[:-2]:     # GQA on the jnp path: repeat kv
+        _FlashDims(q.shape, k.shape, block_q, block_k)   # validates shapes
+        group = q.shape[-3] // k.shape[-3]
+        k = jnp.repeat(k, group, axis=-3)
+        v = jnp.repeat(v, group, axis=-3)
     return blockwise_attention(q, k, v, causal=causal, block_k=block_k)

@@ -209,7 +209,7 @@ def _ring_flash_bwd(axis_name, causal, block_q, block_k, interpret, res, do):
     zeros_kv = k.astype(jnp.float32) * 0.0
     # q-side operands (padded q/do, lse/Δ columns) are step-invariant:
     # prepared once here, only the kv chunk varies inside the scan.
-    dims = _FlashDims(q.shape, k.shape[-2], block_q, block_k)
+    dims = _FlashDims(q.shape, k.shape, block_q, block_k)
     prep = _prepare_flash_bwd_q_side(dims, q, o, lse, do)
 
     def pair_grads(k_cur, v_cur, ring_step):

@@ -162,6 +162,52 @@ class TestTransformerLm:
         assert np.isfinite(float(loss))
 
 
+class TestGroupedQueryAttention:
+    def test_gqa_train_step_and_kv_param_shapes(self, cpus):
+        from petastorm_tpu.models import transformer_lm as tlm
+        cfg = _tiny_config(n_kv_heads=2)     # 4 q heads over 2 kv heads
+        with jax.default_device(cpus[0]):
+            params = tlm.init(jax.random.PRNGKey(0), cfg)
+            assert params['layers'][0]['wk'].shape == (
+                cfg.d_model, 2 * cfg.head_dim)
+            opt, step = tlm.make_train_step(cfg)
+            st = opt.init(params)
+            rng = np.random.default_rng(0)
+            toks = jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32)
+            params2, _, loss = step(params, st, toks, jnp.roll(toks, -1, 1))
+        assert np.isfinite(float(loss))
+        wk0 = np.asarray(params['layers'][0]['wk'])
+        wk1 = np.asarray(params2['layers'][0]['wk'])
+        assert not np.array_equal(wk0, wk1)  # kv projection received grads
+
+    def test_gqa_flash_and_blockwise_agree(self, cpus):
+        """On CPU both attention modes reduce to repeated-kv blockwise, so
+        the model forward must be identical — pins the repeat semantics."""
+        from petastorm_tpu.models import transformer_lm as tlm
+        rng = np.random.default_rng(1)
+        toks = jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32)
+        with jax.default_device(cpus[0]):
+            outs = []
+            for attn in ('blockwise', 'flash'):
+                cfg = _tiny_config(n_kv_heads=1, attention=attn)
+                params = tlm.init(jax.random.PRNGKey(0), cfg)
+                outs.append(np.asarray(tlm.forward(params, toks, cfg)))
+        np.testing.assert_allclose(outs[0], outs[1], atol=1e-5)
+
+    def test_bad_kv_head_ratio_rejected(self):
+        from petastorm_tpu.models import transformer_lm as tlm
+        cfg = _tiny_config(n_kv_heads=3)     # 4 % 3 != 0
+        with pytest.raises(ValueError, match='multiple of n_kv_heads'):
+            tlm.init(jax.random.PRNGKey(0), cfg)
+
+    @pytest.mark.parametrize('top_k', [0, 5])
+    def test_bad_moe_top_k_rejected(self, top_k):
+        from petastorm_tpu.models import transformer_lm as tlm
+        cfg = _tiny_config(n_experts=4, moe_top_k=top_k)
+        with pytest.raises(ValueError, match='moe_top_k'):
+            tlm.init(jax.random.PRNGKey(0), cfg)
+
+
 class TestMoeDispatch:
     def _layer_and_x(self, cfg, rng_seed=0, batch=2, seq=16):
         from petastorm_tpu.models import transformer_lm as tlm
