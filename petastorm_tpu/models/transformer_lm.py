@@ -392,6 +392,120 @@ def loss_fn(params, tokens, targets, config: TransformerConfig, mesh=None):
 
 
 # ---------------------------------------------------------------------------
+# decoding (KV-cache autoregressive generation)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(config: TransformerConfig, batch_size: int, max_len: int):
+    """Per-layer key/value caches ``(B, kv_heads, max_len, head_dim)`` in the
+    model compute dtype."""
+    c = config
+    shape = (batch_size, c.kv_heads, max_len, c.head_dim)
+    return [{'k': jnp.zeros(shape, c.dtype), 'v': jnp.zeros(shape, c.dtype)}
+            for _ in range(c.n_layers)]
+
+
+def _attend_cache(q, ck, cv, index):
+    """One-token attention against the cache: q ``(B, H, 1, dh)``, cache
+    ``(B, Hkv, max, dh)``; positions > ``index`` are masked. GQA-aware (q
+    head groups share a cache head)."""
+    b, h, _, dh = q.shape
+    hkv = ck.shape[1]
+    g = h // hkv
+    qg = q.reshape(b, hkv, g, dh)
+    s = jnp.einsum('bkgd,bkld->bkgl', qg.astype(jnp.float32),
+                   ck.astype(jnp.float32)) / math.sqrt(dh)
+    mask = jnp.arange(ck.shape[2])[None, None, None, :] <= index
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum('bkgl,bkld->bkgd', p, cv.astype(jnp.float32))
+    return o.reshape(b, h, 1, dh).astype(q.dtype)
+
+
+def _decode_layer(x, layer, config: TransformerConfig, cache, index):
+    """One transformer layer for ONE token per sequence (x ``(B, 1, D)``),
+    reading/extending the kv cache at ``index``. Returns (x, cache)."""
+    c = config
+    b = x.shape[0]
+    h, hkv, dh = c.n_heads, c.kv_heads, c.head_dim
+    positions = jnp.reshape(index, (1,))
+
+    hn = _rms_norm(x, layer['ln1'])
+
+    def heads(w, n):
+        y = (hn @ w.astype(hn.dtype)).reshape(b, 1, n, dh)
+        return jnp.transpose(y, (0, 2, 1, 3))
+
+    q = _rope(heads(layer['wq'], h), positions)
+    k_new = _rope(heads(layer['wk'], hkv), positions)
+    v_new = heads(layer['wv'], hkv)
+    ck = jax.lax.dynamic_update_slice(
+        cache['k'], k_new.astype(cache['k'].dtype), (0, 0, index, 0))
+    cv = jax.lax.dynamic_update_slice(
+        cache['v'], v_new.astype(cache['v'].dtype), (0, 0, index, 0))
+    att = _attend_cache(q, ck, cv, index)
+    x = x + (jnp.transpose(att, (0, 2, 1, 3)).reshape(b, 1, h * dh)
+             @ layer['wo'].astype(x.dtype))
+
+    h2 = _rms_norm(x, layer['ln2'])
+    if c.n_experts > 0:
+        ffn_out, _ = _moe_ffn(h2, layer, c)      # aux loss unused at decode
+        x = x + ffn_out
+    else:
+        x = x + _dense_ffn(h2, layer)
+    return x, {'k': ck, 'v': cv}
+
+
+def generate(params, tokens, config: TransformerConfig, max_new_tokens: int,
+             *, temperature: float = 0.0, rng=None):
+    """Autoregressive decoding with per-layer KV caches.
+
+    ``tokens`` ``(B, Lp)`` int32 prompts (same length across the batch) →
+    ``(B, max_new_tokens)`` sampled continuations. ``temperature`` 0 =
+    greedy argmax, > 0 = categorical sampling (seeded by ``rng``). The
+    prompt is prefilled through the same single-token decode path, so
+    prefill and decode are numerically identical; works for dense, MoE, and
+    GQA configs (the cache carries ``kv_heads`` heads). The config's
+    ``attention`` mode only affects training — decode always attends the
+    cache directly. MoE caveat: routing capacity is evaluated per decode
+    step (over B units, not B·L), so expert-overflow drops can differ from
+    the training forward — equivalence is exact only when no drops occur
+    (ample ``moe_capacity_factor``)."""
+    c = config
+    b, prompt_len = tokens.shape
+    total = prompt_len + max_new_tokens
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    caches = init_kv_cache(c, b, total)
+    buf = jnp.concatenate(
+        [tokens, jnp.zeros((b, max_new_tokens), tokens.dtype)], axis=1)
+
+    def step(carry, t):
+        buf, caches, rng = carry
+        x = params['embed'].astype(c.dtype)[buf[:, t]][:, None, :]
+        new_caches = []
+        for layer, cache in zip(params['layers'], caches):
+            x, cache = _decode_layer(x, layer, c, cache, t)
+            new_caches.append(cache)
+        x = _rms_norm(x, params['final_norm'])
+        logits = (x @ params['unembed'].astype(c.dtype))[:, 0].astype(
+            jnp.float32)
+        rng, sub = jax.random.split(rng)
+        if temperature == 0.0:
+            nxt = jnp.argmax(logits, axis=-1).astype(buf.dtype)
+        else:
+            nxt = jax.random.categorical(
+                sub, logits / temperature).astype(buf.dtype)
+        # keep prompt tokens during prefill; write samples after it
+        buf = buf.at[:, t + 1].set(
+            jnp.where(t + 1 < prompt_len, buf[:, t + 1], nxt))
+        return (buf, new_caches, rng), None
+
+    (buf, _, _), _ = jax.lax.scan(step, (buf, caches, rng),
+                                  jnp.arange(total - 1))
+    return buf[:, prompt_len:]
+
+
+# ---------------------------------------------------------------------------
 # training
 # ---------------------------------------------------------------------------
 

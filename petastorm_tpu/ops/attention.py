@@ -612,8 +612,11 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
                             lambda b, i, j: (kvmap(b), i, 0))
     outspec_i = pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, i, 0))
     rowspec_j = pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, j, 0))
-    # per-Q-head float32 partials: exact for group == 1 too (the f32→storage
-    # cast just moves from the kernel's final write to after the group sum)
+    # GQA emits per-Q-head float32 partials (exact cross-head sum before the
+    # storage cast); plain MHA writes k/v dtype directly — no extra HBM
+    # traffic or cast pass on the common path
+    part_dtypes = ((jnp.float32, jnp.float32) if dims.group > 1
+                   else (k.dtype, v.dtype))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, block_q=bq, block_k=bk,
                           causal=causal, scale=scale, kv_seq_len=kv_len,
@@ -621,8 +624,8 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
         grid=(flat, num_kv_blocks, num_q_blocks),
         in_specs=[qspec_j, kvspec_i, kvspec_i, qspec_j, rowspec_j, rowspec_j],
         out_specs=[outspec_i, outspec_i],
-        out_shape=[_sds((flat, pk_len, head_dim), jnp.float32, vma),
-                   _sds((flat, pk_len, head_dim), jnp.float32, vma)],
+        out_shape=[_sds((flat, pk_len, head_dim), part_dtypes[0], vma),
+                   _sds((flat, pk_len, head_dim), part_dtypes[1], vma)],
         scratch_shapes=[pltpu.VMEM((bk, head_dim), jnp.float32),
                         pltpu.VMEM((bk, head_dim), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -630,8 +633,9 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, deltaf)
 
-    dk = dims.sum_head_groups(dk).astype(k.dtype)
-    dv = dims.sum_head_groups(dv).astype(v.dtype)
+    if dims.group > 1:
+        dk = dims.sum_head_groups(dk).astype(k.dtype)
+        dv = dims.sum_head_groups(dv).astype(v.dtype)
     return dims.unpad_q_like(dq), dims.unpad_kv_like(dk), dims.unpad_kv_like(dv)
 
 
