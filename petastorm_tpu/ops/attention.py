@@ -107,11 +107,72 @@ def _kv_block_mask(q_pos, blk_idx, block_k: int, kv_len: int, causal: bool):
     return mask
 
 
-def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512):
+def _seg_to_kv_blocks(seg, num_blocks: int, block_k: int, pad_value: int):
+    """(..., L) segment ids → (nb, ..., bk) blocks (tail padded with a
+    sentinel that never equals a real segment)."""
+    pad = num_blocks * block_k - seg.shape[-1]
+    if pad:
+        seg = jnp.pad(seg, [(0, 0)] * (seg.ndim - 1) + [(0, pad)],
+                      constant_values=pad_value)
+    seg = jnp.moveaxis(seg, -1, 0)
+    seg = seg.reshape((num_blocks, block_k) + seg.shape[1:])
+    return jnp.moveaxis(seg, 1, -1)
+
+
+def _segment_mask(seg_q, seg_k_blk):
+    """(..., Lq) × (..., bk) → (..., Lq, bk) same-segment mask."""
+    return seg_q[..., :, None] == seg_k_blk[..., None, :]
+
+
+def _normalize_seg(seg, target_ndim: int, length: int, name: str):
+    """Validate a segment-id array's sequence length and insert singleton
+    head/batch axes until it broadcasts against ``(..., L)`` operands of
+    ``target_ndim`` dims — callers pass ``(B, L)``, ``(L,)`` or the full
+    per-head shape interchangeably. Ids must be non-negative (negative values
+    collide with the internal pad sentinels); checked when concrete."""
+    seg = jnp.asarray(seg)
+    if seg.shape[-1] != length or seg.ndim > target_ndim:
+        raise ValueError(
+            '%s must have shape (..., %d) broadcastable over the attention '
+            'operands; got %r' % (name, length, seg.shape))
+    try:
+        import numpy as _np
+        if (_np.asarray(seg) < 0).any():
+            raise ValueError('%s must be non-negative (negative ids collide '
+                             'with internal padding sentinels)' % name)
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        pass          # traced inside jit: contract documented, not checkable
+    while seg.ndim < target_ndim:
+        seg = seg[..., None, :]
+    return seg
+
+
+def _resolve_segs(segment_ids, kv_segment_ids, q_ndim: int, k_ndim: int,
+                  q_len: int, kv_len: int):
+    """ONE definition of segment-argument semantics for every path (jnp
+    blockwise, jnp backward, Pallas forward/backward): kv ids default to the
+    q ids; kv-only masking is rejected loudly instead of silently ignored.
+    Returns ``(seg_q, kv_seg)`` normalized, or ``(None, None)``."""
+    if segment_ids is None:
+        if kv_segment_ids is not None:
+            raise ValueError('kv_segment_ids requires segment_ids (kv-only '
+                             'masking has no q-side ids to compare against)')
+        return None, None
+    seg_q = _normalize_seg(segment_ids, q_ndim - 1, q_len, 'segment_ids')
+    kv_seg = segment_ids if kv_segment_ids is None else kv_segment_ids
+    kv_seg = _normalize_seg(kv_seg, k_ndim - 1, kv_len, 'kv_segment_ids')
+    return seg_q, kv_seg
+
+
+def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512,
+                        segment_ids=None, kv_segment_ids=None):
     """Memory-efficient attention: scan over key/value blocks with online
     softmax. Works on any backend; O(L·block_k) live memory per head.
 
     Shapes: q/k/v ``(..., L, D)``; returns ``(..., L, D)`` in q's dtype.
+    ``segment_ids`` ``(..., Lq)`` restricts attention to same-segment pairs
+    (packed sequences); ``kv_segment_ids`` defaults to ``segment_ids``.
     """
     orig_dtype = q.dtype
     q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
@@ -122,18 +183,28 @@ def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512):
     k32, v32, num_blocks = _pad_kv(k32, v32, block_k)
     kb = _to_kv_blocks(k32, num_blocks, block_k)
     vb = _to_kv_blocks(v32, num_blocks, block_k)
+    seg_q, kv_seg = _resolve_segs(segment_ids, kv_segment_ids, q.ndim,
+                                  k.ndim, q_len, k_len)
+    if seg_q is not None:
+        segb = _seg_to_kv_blocks(kv_seg, num_blocks, block_k, pad_value=-2)
     q_pos = jnp.arange(q_len)
     o, m, l = attention_accumulators(q_len, q.shape[-1], batch_shape)
 
     def step(carry, inputs):
         o, m, l = carry
-        k_blk, v_blk, blk_idx = inputs
-        mask = _kv_block_mask(q_pos, blk_idx, block_k, k_len, causal)
+        if segment_ids is not None:
+            k_blk, v_blk, seg_blk, blk_idx = inputs
+            mask = (_kv_block_mask(q_pos, blk_idx, block_k, k_len, causal)
+                    & _segment_mask(seg_q, seg_blk))
+        else:
+            k_blk, v_blk, blk_idx = inputs
+            mask = _kv_block_mask(q_pos, blk_idx, block_k, k_len, causal)
         o, m, l = _block_update(q32, k_blk, v_blk, o, m, l, scale, mask)
         return (o, m, l), None
 
-    (o, m, l), _ = jax.lax.scan(step, (o, m, l),
-                                (kb, vb, jnp.arange(num_blocks)))
+    xs = ((kb, vb, segb, jnp.arange(num_blocks)) if segment_ids is not None
+          else (kb, vb, jnp.arange(num_blocks)))
+    (o, m, l), _ = jax.lax.scan(step, (o, m, l), xs)
     return finalize_attention(o, l).astype(orig_dtype)
 
 
@@ -141,9 +212,9 @@ def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512):
 # Pallas TPU kernel
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *refs, block_q: int,
+def _flash_kernel(q_ref, k_ref, v_ref, *refs, block_q: int,
                   block_k: int, causal: bool, scale: float, kv_seq_len: int,
-                  num_kv_blocks: int, with_lse: bool):
+                  num_kv_blocks: int, with_lse: bool, segmented: bool = False):
     """One (batch·head, q-block, kv-block) grid step.
 
     KV **streams through the grid**: each program sees only a (block_k, D)
@@ -153,9 +224,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *refs, block_q: int,
     online-softmax accumulators (o, m, l) persist across the sequential
     kv-block grid dimension in VMEM scratch; the final kv step normalizes and
     writes the output block plus its logsumexp (saved for the backward).
+    ``segmented`` adds per-token segment ids (packed sequences): pairs in
+    different segments are masked out.
     """
     from jax.experimental import pallas as pl
 
+    if segmented:
+        segq_ref, segkv_ref, *refs = refs
+    else:
+        segq_ref = segkv_ref = None
+    o_ref, *refs = refs
     if with_lse:
         lse_ref, acc_ref, m_ref, l_ref = refs
     else:
@@ -189,6 +267,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *refs, block_q: int,
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, 1), 0)
             mask = mask & (q_pos >= k_pos)
+        if segmented:
+            # segq (bq, 1); segkv stored sublane-replicated (8, bk)
+            mask = mask & (segq_ref[...] == segkv_ref[0:1, :])
         mask = jnp.broadcast_to(mask, s.shape)
         s = jnp.where(mask, s, _NEG_INF)
         m_prev = m_ref[...][:, :1]                     # (bq, 1)
@@ -316,6 +397,36 @@ class _FlashDims:
         return x[:, :self.kv_len, :].reshape(
             self.kv_batch + (self.kv_len, self.head_dim))
 
+    def pad_seg_q(self, seg):
+        """Broadcast + pad q-side segment ids to ``(flat, pq_len, 1)`` int32
+        (pad sentinel -1: padded q rows match nothing real)."""
+        seg = jnp.broadcast_to(seg, self.batch + (self.q_len,))
+        if self.pad_q:
+            seg = jnp.pad(seg, [(0, 0)] * (seg.ndim - 1) + [(0, self.pad_q)],
+                          constant_values=-1)
+        return seg.astype(jnp.int32).reshape(self.flat, self.pq_len, 1)
+
+    def pad_seg_kv(self, seg):
+        """Broadcast + pad kv-side segment ids to ``(kv_flat, 8, pk_len)``
+        int32 — sublane-replicated so the kernel's ``(8, bk)`` block is
+        lowerable; pad sentinel -2 never equals a q-side id."""
+        seg = jnp.broadcast_to(seg, self.kv_batch + (self.kv_len,))
+        if self.pad_k:
+            seg = jnp.pad(seg, [(0, 0)] * (seg.ndim - 1) + [(0, self.pad_k)],
+                          constant_values=-2)
+        seg = seg.astype(jnp.int32).reshape(self.kv_flat, 1, self.pk_len)
+        return jnp.broadcast_to(seg, (self.kv_flat, 8, self.pk_len))
+
+    def check_segment_blocks(self, interpret: bool):
+        """The kv segment block rides with ``block_k`` lanes; Mosaic wants
+        the lane dim a multiple of 128 (or the full array dim). Interpret
+        mode has no such constraint."""
+        if not interpret and self.bk % 128 != 0 and self.bk != self.pk_len:
+            raise ValueError(
+                'segment_ids on the TPU Pallas path need block_k %% 128 == 0 '
+                '(got block_k=%d); use the default block sizes or interpret '
+                'mode' % self.bk)
+
     def sum_head_groups(self, x):
         """Per-q-head kv gradients ``(flat, L, D)`` → per-kv-head
         ``(kv_flat, L, D)`` by summing each head group (identity when not
@@ -330,11 +441,13 @@ class _FlashDims:
 
 
 def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
-                  interpret: bool = False, with_lse: bool = True):
+                  interpret: bool = False, with_lse: bool = True,
+                  segment_ids=None, kv_segment_ids=None):
     """Returns ``(o, lse)`` with o in q's dtype and lse float32 ``(..., Lq)``
     — lse is None when ``with_lse=False`` (the no-grad forward skips the
     lane-replicated lse write entirely). Non-block-divisible lengths are
-    padded and the pad is masked/sliced."""
+    padded and the pad is masked/sliced. ``segment_ids`` masks cross-segment
+    pairs (packed sequences)."""
     from jax.experimental import pallas as pl
     import jax.experimental.pallas.tpu as pltpu
 
@@ -347,10 +460,27 @@ def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
     qf = dims.pad_q_like(q)
     kf = dims.pad_kv_like(k)
     vf = dims.pad_kv_like(v)
+    seg_q, kv_seg = _resolve_segs(segment_ids, kv_segment_ids, q.ndim,
+                                  k.ndim, q_len, kv_len)
+    segmented = seg_q is not None
+    in_specs = [
+        pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (kvmap(b), j, 0)),
+        pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (kvmap(b), j, 0)),
+    ]
+    inputs = [qf, kf, vf]
+    if segmented:
+        dims.check_segment_blocks(interpret)
+        inputs += [dims.pad_seg_q(seg_q), dims.pad_seg_kv(kv_seg)]
+        in_specs += [
+            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, 8, bk), lambda b, i, j: (kvmap(b), 0, j)),
+        ]
 
     kernel = functools.partial(
         _flash_kernel, block_q=bq, block_k=bk, causal=causal, scale=scale,
-        kv_seq_len=kv_len, num_kv_blocks=num_kv_blocks, with_lse=with_lse)
+        kv_seq_len=kv_len, num_kv_blocks=num_kv_blocks, with_lse=with_lse,
+        segmented=segmented)
     vma = _out_vma(q, k, v)
     out_specs = [pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0))]
     out_shape = [_sds((flat, pq_len, head_dim), q.dtype, vma)]
@@ -360,13 +490,7 @@ def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
     result = pl.pallas_call(
         kernel,
         grid=(flat, pq_len // bq, num_kv_blocks),
-        in_specs=[
-            pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, bk, head_dim),
-                         lambda b, i, j: (kvmap(b), j, 0)),
-            pl.BlockSpec((None, bk, head_dim),
-                         lambda b, i, j: (kvmap(b), j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -377,7 +501,7 @@ def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*inputs)
     o = dims.unpad_q_like(result[0])
     if not with_lse:
         return o, None
@@ -386,7 +510,8 @@ def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
 
 
 def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_k: int,
-                    scale: Optional[float] = None):
+                    scale: Optional[float] = None, segment_ids=None,
+                    kv_segment_ids=None):
     """Memory-efficient flash backward (any backend): scan over kv blocks,
     recomputing p from (q, k, lse); O(Lq·block_k) live memory.
 
@@ -402,13 +527,22 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_k: int,
     k32, v32, num_blocks = _pad_kv(k32, v32, bk)
     kb = _to_kv_blocks(k32, num_blocks, bk)
     vb = _to_kv_blocks(v32, num_blocks, bk)
+    seg_q, kv_seg = _resolve_segs(segment_ids, kv_segment_ids, q.ndim,
+                                  k.ndim, q_len, kv_len)
+    if seg_q is not None:
+        segb = _seg_to_kv_blocks(kv_seg, num_blocks, bk, pad_value=-2)
     q_pos = jnp.arange(q_len)
     # D_i = rowsum(do_i * o_i) — the only residual beyond lse
     d_term = jnp.sum(do32 * o32, axis=-1)            # (..., Lq)
 
     def step(dq, inputs):
-        k_blk, v_blk, blk_idx = inputs
-        mask = _kv_block_mask(q_pos, blk_idx, bk, kv_len, causal)
+        if segment_ids is not None:
+            k_blk, v_blk, seg_blk, blk_idx = inputs
+            mask = (_kv_block_mask(q_pos, blk_idx, bk, kv_len, causal)
+                    & _segment_mask(seg_q, seg_blk))
+        else:
+            k_blk, v_blk, blk_idx = inputs
+            mask = _kv_block_mask(q_pos, blk_idx, bk, kv_len, causal)
         s = jnp.einsum('...qd,...kd->...qk', q32, k_blk) * scale
         p = jnp.exp(s - lse[..., None])
         p = jnp.where(jnp.broadcast_to(mask, p.shape), p, 0.0)
@@ -420,8 +554,9 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_k: int,
         return dq, (dk_blk, dv_blk)
 
     dq0 = jnp.zeros(q32.shape, jnp.float32)
-    dq, (dkb, dvb) = jax.lax.scan(step, dq0,
-                                  (kb, vb, jnp.arange(num_blocks)))
+    xs = ((kb, vb, segb, jnp.arange(num_blocks)) if segment_ids is not None
+          else (kb, vb, jnp.arange(num_blocks)))
+    dq, (dkb, dvb) = jax.lax.scan(step, dq0, xs)
     dk = _from_kv_blocks(dkb, num_blocks, bk)[..., :kv_len, :]
     dv = _from_kv_blocks(dvb, num_blocks, bk)[..., :kv_len, :]
     return (dq.astype(orig_dtypes[0]), dk.astype(orig_dtypes[1]),
@@ -430,10 +565,12 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_k: int,
 
 def _bwd_recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
                         q_idx, kv_idx, block_q: int, block_k: int,
-                        causal: bool, scale: float, kv_seq_len: int):
+                        causal: bool, scale: float, kv_seq_len: int,
+                        segq_ref=None, segkv_ref=None):
     """Shared recomputation block of both backward kernels: rebuild the
     probabilities p = exp(s − lse) for one (q-block, kv-block) tile (masking
-    kv tail padding and causality; lse == _NEG_INF marks a fully-masked row
+    kv tail padding, causality, and — when segment refs are given — packed
+    cross-segment pairs; lse == _NEG_INF marks a fully-masked row
     — forward convention — and exp would overflow there, so it is gated out
     explicitly), then ds = p·(do·vᵀ − Δ)·scale. Returns float32 operand
     views plus (p, ds)."""
@@ -452,6 +589,8 @@ def _bwd_recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
         q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, 1), 0)
         mask = mask & (q_pos >= k_pos)
+    if segq_ref is not None:
+        mask = mask & (segq_ref[...] == segkv_ref[0:1, :])
     mask = jnp.broadcast_to(mask, s.shape)
     live = mask & jnp.broadcast_to(lse > _NEG_INF / 2, s.shape)
     p = jnp.where(live, jnp.exp(s - lse), 0.0)      # (bq, bk)
@@ -462,9 +601,9 @@ def _bwd_recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_acc, *, block_q: int, block_k: int,
+                         *refs, block_q: int, block_k: int,
                          causal: bool, scale: float, kv_seq_len: int,
-                         num_kv_blocks: int):
+                         num_kv_blocks: int, segmented: bool = False):
     """dq pass: one (batch·head, q-block, kv-block) grid step; kv streams
     through the grid (like the forward), dq accumulates in VMEM scratch across
     the sequential kv dimension and is written on the final kv step.
@@ -473,6 +612,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     Δ = rowsum(do·o) precomputed outside the kernel."""
     from jax.experimental import pallas as pl
 
+    if segmented:
+        segq_ref, segkv_ref, dq_ref, dq_acc = refs
+    else:
+        segq_ref = segkv_ref = None
+        dq_ref, dq_acc = refs
     q_idx = pl.program_id(1)
     kv_idx = pl.program_id(2)
 
@@ -490,7 +634,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         _, k, _, _, ds = _bwd_recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_idx=q_idx,
             kv_idx=kv_idx, block_q=block_q, block_k=block_k, causal=causal,
-            scale=scale, kv_seq_len=kv_seq_len)
+            scale=scale, kv_seq_len=kv_seq_len, segq_ref=segq_ref,
+            segkv_ref=segkv_ref)
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -501,15 +646,21 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                           dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                           *refs, block_q: int,
                            block_k: int, causal: bool, scale: float,
-                           kv_seq_len: int, num_q_blocks: int):
+                           kv_seq_len: int, num_q_blocks: int,
+                           segmented: bool = False):
     """dk/dv pass: one (batch·head, kv-block, q-block) grid step; q (and do,
     lse, Δ) stream through the grid, dk/dv accumulate in VMEM scratch across
     the sequential q dimension. Padded q rows carry do == 0, so they
     contribute nothing and need no extra mask."""
     from jax.experimental import pallas as pl
 
+    if segmented:
+        segq_ref, segkv_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        segq_ref = segkv_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
     kv_idx = pl.program_id(1)
     q_idx = pl.program_id(2)
 
@@ -528,7 +679,8 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q, _, do, p, ds = _bwd_recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_idx=q_idx,
             kv_idx=kv_idx, block_q=block_q, block_k=block_k, causal=causal,
-            scale=scale, kv_seq_len=kv_seq_len)
+            scale=scale, kv_seq_len=kv_seq_len, segq_ref=segq_ref,
+            segkv_ref=segkv_ref)
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bk, D)
@@ -553,7 +705,8 @@ def _prepare_flash_bwd_q_side(dims: '_FlashDims', q, o, lse, do):
 
 
 def _pallas_flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
-                           block_k: int, interpret: bool = False):
+                           block_k: int, interpret: bool = False,
+                           segment_ids=None, kv_segment_ids=None):
     """Fused flash backward: two Pallas kernels (dq; dk/dv), both streaming
     the non-owned operand through the grid — bounded VMEM at any length, like
     the forward. Returns (dq, dk, dv) in the input dtypes.
@@ -563,14 +716,23 @@ def _pallas_flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
     the 128-lane replication the forward's lse *output* needs."""
     dims = _FlashDims(q.shape, k.shape, block_q, block_k)
     prep = _prepare_flash_bwd_q_side(dims, q, o, lse, do)
+    seg_q, kv_seg = _resolve_segs(segment_ids, kv_segment_ids, q.ndim,
+                                  k.ndim, dims.q_len, dims.kv_len)
+    segs = None
+    if seg_q is not None:
+        dims.check_segment_blocks(interpret)
+        segs = (dims.pad_seg_q(seg_q), dims.pad_seg_kv(kv_seg))
     return _flash_backward_from_prepared(dims, prep, k, v, causal=causal,
-                                         interpret=interpret)
+                                         interpret=interpret, segs=segs)
 
 
 def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
-                                  causal: bool, interpret: bool = False):
+                                  causal: bool, interpret: bool = False,
+                                  segs=None):
     """Backward kernels given pre-padded q-side operands (see
     :func:`_prepare_flash_bwd_q_side`); only the kv chunk varies per call.
+    ``segs``: optional pre-padded ``(seg_q, seg_kv)`` from ``pad_seg_q`` /
+    ``pad_seg_kv`` for packed-sequence masking.
 
     GQA: the dk/dv kernel runs one program per Q head (reading the shared kv
     row via the head map) and emits per-q-head float32 partials that are
@@ -588,30 +750,45 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
     kf = dims.pad_kv_like(k)
     vf = dims.pad_kv_like(v)
     vma = _out_vma(qf, k, v, dof)
+    segmented = segs is not None
 
     qspec = pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0))
     kvspec_j = pl.BlockSpec((None, bk, head_dim),
                             lambda b, i, j: (kvmap(b), j, 0))
     rowspec_i = pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0))
+    dq_inputs = [qf, kf, vf, dof, lsef, deltaf]
+    dq_specs = [qspec, kvspec_j, kvspec_j, qspec, rowspec_i, rowspec_i]
+    if segmented:
+        dq_inputs += list(segs)
+        dq_specs += [rowspec_i,
+                     pl.BlockSpec((None, 8, bk),
+                                  lambda b, i, j: (kvmap(b), 0, j))]
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=bq, block_k=bk,
                           causal=causal, scale=scale, kv_seq_len=kv_len,
-                          num_kv_blocks=num_kv_blocks),
+                          num_kv_blocks=num_kv_blocks, segmented=segmented),
         grid=(flat, num_q_blocks, num_kv_blocks),
-        in_specs=[qspec, kvspec_j, kvspec_j, qspec, rowspec_i, rowspec_i],
+        in_specs=dq_specs,
         out_specs=qspec,
         out_shape=_sds((flat, pq_len, head_dim), qf.dtype, vma),
         scratch_shapes=[pltpu.VMEM((bq, head_dim), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(*dq_inputs)
 
     qspec_j = pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, j, 0))
     kvspec_i = pl.BlockSpec((None, bk, head_dim),
                             lambda b, i, j: (kvmap(b), i, 0))
     outspec_i = pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, i, 0))
     rowspec_j = pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, j, 0))
+    dkdv_inputs = [qf, kf, vf, dof, lsef, deltaf]
+    dkdv_specs = [qspec_j, kvspec_i, kvspec_i, qspec_j, rowspec_j, rowspec_j]
+    if segmented:
+        dkdv_inputs += list(segs)
+        dkdv_specs += [rowspec_j,
+                       pl.BlockSpec((None, 8, bk),
+                                    lambda b, i, j: (kvmap(b), 0, i))]
     # GQA emits per-Q-head float32 partials (exact cross-head sum before the
     # storage cast); plain MHA writes k/v dtype directly — no extra HBM
     # traffic or cast pass on the common path
@@ -620,9 +797,9 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, block_q=bq, block_k=bk,
                           causal=causal, scale=scale, kv_seq_len=kv_len,
-                          num_q_blocks=num_q_blocks),
+                          num_q_blocks=num_q_blocks, segmented=segmented),
         grid=(flat, num_kv_blocks, num_q_blocks),
-        in_specs=[qspec_j, kvspec_i, kvspec_i, qspec_j, rowspec_j, rowspec_j],
+        in_specs=dkdv_specs,
         out_specs=[outspec_i, outspec_i],
         out_shape=[_sds((flat, pk_len, head_dim), part_dtypes[0], vma),
                    _sds((flat, pk_len, head_dim), part_dtypes[1], vma)],
@@ -631,7 +808,7 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(*dkdv_inputs)
 
     if dims.group > 1:
         dk = dims.sum_head_groups(dk).astype(k.dtype)
@@ -680,35 +857,45 @@ def merge_attention_chunks(o_acc, m, l, o_i, lse_i):
     return o_acc, m_new, l * corr + w
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, block_q, block_k, interpret, bwd_backend):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, seg_q, seg_kv, causal, block_q, block_k, interpret,
+           bwd_backend):
     o, _ = _pallas_flash(q, k, v, causal, block_q, block_k, interpret,
-                         with_lse=False)
+                         with_lse=False, segment_ids=seg_q,
+                         kv_segment_ids=seg_kv)
     return o
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_backend):
-    o, lse = _pallas_flash(q, k, v, causal, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, seg_q, seg_kv, causal, block_q, block_k, interpret,
+               bwd_backend):
+    o, lse = _pallas_flash(q, k, v, causal, block_q, block_k, interpret,
+                           segment_ids=seg_q, kv_segment_ids=seg_kv)
+    return o, (q, k, v, o, lse, seg_q, seg_kv)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, bwd_backend, res, do):
-    q, k, v, o, lse = res
+    q, k, v, o, lse, seg_q, seg_kv = res
     if bwd_backend == 'pallas':
-        return _pallas_flash_backward(q, k, v, o, lse, do, causal=causal,
-                                      block_q=block_q, block_k=block_k,
-                                      interpret=interpret)
+        grads = _pallas_flash_backward(q, k, v, o, lse, do, causal=causal,
+                                       block_q=block_q, block_k=block_k,
+                                       interpret=interpret, segment_ids=seg_q,
+                                       kv_segment_ids=seg_kv)
+        return grads + (None, None)
     if q.shape[:-2] != k.shape[:-2]:     # GQA through the jnp oracle:
         group = q.shape[-3] // k.shape[-3]
         kr = jnp.repeat(k, group, axis=-3)
         vr = jnp.repeat(v, group, axis=-3)
         dq, dkr, dvr = _flash_backward(q, kr, vr, o, lse, do, causal=causal,
-                                       block_k=block_k)
+                                       block_k=block_k, segment_ids=seg_q,
+                                       kv_segment_ids=seg_kv)
         shape = k.shape[:-3] + (k.shape[-3], group) + k.shape[-2:]
         dk = dkr.astype(jnp.float32).reshape(shape).sum(axis=-3)
         dv = dvr.astype(jnp.float32).reshape(shape).sum(axis=-3)
-        return dq, dk.astype(k.dtype), dv.astype(v.dtype)
-    return _flash_backward(q, k, v, o, lse, do, causal=causal, block_k=block_k)
+        return dq, dk.astype(k.dtype), dv.astype(v.dtype), None, None
+    dq, dk, dv = _flash_backward(q, k, v, o, lse, do, causal=causal,
+                                 block_k=block_k, segment_ids=seg_q,
+                                 kv_segment_ids=seg_kv)
+    return dq, dk, dv, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -716,7 +903,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
                     block_k: int = 512, backend: Optional[str] = None,
-                    bwd: Optional[str] = None):
+                    bwd: Optional[str] = None, segment_ids=None,
+                    kv_segment_ids=None):
     """Fused attention over ``(..., L, D)`` inputs; differentiable (custom_vjp
     with fused Pallas backward kernels), any sequence length (padded to block
     multiples internally).
@@ -725,6 +913,13 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
     except axis -3, q heads a multiple of kv heads). The Pallas path reads
     shared kv blocks via the head map — repeated kv is never materialized in
     HBM; the jnp fallback repeats kv explicitly.
+
+    Packed sequences: ``segment_ids`` ``(..., Lq)`` (int; broadcastable over
+    batch/head dims) masks attention to same-segment pairs — the contract
+    for multi-document packing is that packed attention equals per-document
+    attention (``tests/test_flash_segments.py``). ``kv_segment_ids``
+    defaults to ``segment_ids``. On the TPU Pallas path ``block_k`` must be
+    a multiple of 128 when segments are used (the defaults are).
 
     ``backend``: 'pallas' forces the TPU kernel, 'jnp' the scan fallback,
     'interpret' the Pallas interpreter (CI on CPU); default picks Pallas on TPU.
@@ -745,8 +940,8 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
     if bwd not in (None, 'pallas', 'jnp'):
         raise ValueError("bwd must be 'pallas' or 'jnp', got %r" % (bwd,))
     if backend in ('pallas', 'interpret'):
-        return _flash(q, k, v, causal, block_q, block_k,
-                      backend == 'interpret', bwd or 'pallas')
+        return _flash(q, k, v, segment_ids, kv_segment_ids, causal, block_q,
+                      block_k, backend == 'interpret', bwd or 'pallas')
     if bwd is not None:
         raise ValueError("bwd applies only to the Pallas path (backend "
                          "'pallas' or 'interpret'); the %r backend "
@@ -757,4 +952,6 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
         group = q.shape[-3] // k.shape[-3]
         k = jnp.repeat(k, group, axis=-3)
         v = jnp.repeat(v, group, axis=-3)
-    return blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+    return blockwise_attention(q, k, v, causal=causal, block_k=block_k,
+                               segment_ids=segment_ids,
+                               kv_segment_ids=kv_segment_ids)
