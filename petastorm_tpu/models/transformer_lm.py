@@ -215,7 +215,8 @@ def _ring_attention_sharded(q, k, v, mesh):
     return fn(q, k, v)
 
 
-def _attention(x, layer, config: TransformerConfig, positions, mesh=None):
+def _attention(x, layer, config: TransformerConfig, positions, mesh=None,
+               segment_ids=None):
     c = config
     b, l, _ = x.shape
     h, hkv, dh = c.n_heads, c.kv_heads, c.head_dim
@@ -234,13 +235,18 @@ def _attention(x, layer, config: TransformerConfig, positions, mesh=None):
         v = jnp.repeat(v, h // hkv, axis=1)
 
     if c.attention == 'ring':
+        if segment_ids is not None:
+            raise ValueError('packed segment_ids are not supported with '
+                             "attention='ring' (use 'flash'/'blockwise', or "
+                             'shard unpacked sequences)')
         if mesh is None or 'seq' not in mesh.axis_names:
             raise ValueError("attention='ring' needs a mesh with a 'seq' axis")
         o = _ring_attention_sharded(q, k, v, mesh)
     elif c.attention == 'flash':
-        o = flash_attention(q, k, v, causal=True)
+        o = flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
     else:
-        o = blockwise_attention(q, k, v, causal=True)
+        o = blockwise_attention(q, k, v, causal=True,
+                                segment_ids=segment_ids)
     o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, l, h * dh)
     return o @ layer['wo'].astype(x.dtype)
 
@@ -351,21 +357,43 @@ def _moe_ffn(x, layer, config: TransformerConfig, mesh=None):
     return y.reshape(b, l, d), aux
 
 
+def _segment_positions(segment_ids):
+    """Per-document positions derived from (B, L) segment ids: 0, 1, 2, …
+    restarting wherever the segment changes (matches
+    ``packing.pack_documents``' positions for contiguous segments)."""
+    seg = jnp.asarray(segment_ids)
+    idx = jnp.arange(seg.shape[-1])
+    boundary = jnp.concatenate(
+        [jnp.ones_like(seg[..., :1], bool),
+         seg[..., 1:] != seg[..., :-1]], axis=-1)
+    starts = jax.lax.cummax(jnp.where(boundary, idx, 0), axis=seg.ndim - 1)
+    return idx - starts
+
+
 def forward(params, tokens, config: TransformerConfig,
             positions: Optional[jnp.ndarray] = None, mesh=None,
-            return_aux: bool = False):
+            return_aux: bool = False, segment_ids=None):
     """tokens (B, L) int32 → logits (B, L, vocab) float32.
 
     With ``return_aux=True`` also returns the summed MoE load-balancing
-    auxiliary loss (0.0 for dense models)."""
+    auxiliary loss (0.0 for dense models). ``segment_ids`` (B, L) enables
+    packed multi-document batches (see ``petastorm_tpu.packing``): attention
+    is masked to same-segment pairs — pass the packer's per-document
+    ``positions`` too so rotary offsets restart per document."""
     c = config
     if positions is None:
-        positions = jnp.arange(tokens.shape[1])
+        if segment_ids is not None:
+            # restart rotary offsets at every document boundary — silently
+            # continuing a neighbor's offsets would train position encodings
+            # inconsistent with unpacked inference
+            positions = _segment_positions(segment_ids)
+        else:
+            positions = jnp.arange(tokens.shape[1])
     x = params['embed'].astype(c.dtype)[tokens]              # (B, L, D)
     aux_total = jnp.zeros((), jnp.float32)
     for layer in params['layers']:
         h = _rms_norm(x, layer['ln1'])
-        x = x + _attention(h, layer, c, positions, mesh)
+        x = x + _attention(h, layer, c, positions, mesh, segment_ids)
         h = _rms_norm(x, layer['ln2'])
         if c.n_experts > 0:
             ffn_out, aux = _moe_ffn(h, layer, c, mesh)
@@ -378,14 +406,27 @@ def forward(params, tokens, config: TransformerConfig,
     return (logits, aux_total) if return_aux else logits
 
 
-def loss_fn(params, tokens, targets, config: TransformerConfig, mesh=None):
+def loss_fn(params, tokens, targets, config: TransformerConfig, mesh=None,
+            *, positions=None, segment_ids=None, weights=None):
     """Next-token cross entropy (+ weighted MoE load-balance aux for expert
     models); ``targets`` are tokens shifted by the caller (the NGram pipeline
-    emits aligned (input, target) windows)."""
-    logits, aux = forward(params, tokens, config, mesh=mesh, return_aux=True)
+    emits aligned (input, target) windows).
+
+    Packed multi-document batches (``petastorm_tpu.packing``): pass the
+    packer's ``positions``/``segment_ids`` plus the ``weights`` from
+    ``packed_lm_targets`` — attention is segment-masked, rotary offsets
+    restart per document, and padding/document-boundary slots get zero loss
+    weight (mean over weighted slots only)."""
+    logits, aux = forward(params, tokens, config, positions=positions,
+                          mesh=mesh, return_aux=True,
+                          segment_ids=segment_ids)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    loss = jnp.mean(nll)
+    if weights is None:
+        loss = jnp.mean(nll)
+    else:
+        loss = (jnp.sum(nll * weights)
+                / jnp.maximum(jnp.sum(weights), 1.0))
     if config.n_experts > 0 and config.moe_aux_weight:
         loss = loss + config.moe_aux_weight * aux
     return loss

@@ -148,6 +148,18 @@ def _normalize_seg(seg, target_ndim: int, length: int, name: str):
     return seg
 
 
+def _repeat_kv_seg(kv_seg, k, group: int):
+    """When the jnp GQA fallback head-repeats k/v, a PER-HEAD kv segment-id
+    array (carrying the kv head axis) must be repeated the same way; head-free
+    ``(B, L)`` / ``(L,)`` ids broadcast and pass through unchanged."""
+    if kv_seg is None or group == 1:
+        return kv_seg
+    kv_seg = jnp.asarray(kv_seg)
+    if kv_seg.ndim >= k.ndim - 1 and kv_seg.shape[-2] == k.shape[-3]:
+        return jnp.repeat(kv_seg, group, axis=-2)
+    return kv_seg
+
+
 def _resolve_segs(segment_ids, kv_segment_ids, q_ndim: int, k_ndim: int,
                   q_len: int, kv_len: int):
     """ONE definition of segment-argument semantics for every path (jnp
@@ -885,9 +897,10 @@ def _flash_bwd(causal, block_q, block_k, interpret, bwd_backend, res, do):
         group = q.shape[-3] // k.shape[-3]
         kr = jnp.repeat(k, group, axis=-3)
         vr = jnp.repeat(v, group, axis=-3)
+        seg_kv_r = _repeat_kv_seg(seg_kv, k, group)
         dq, dkr, dvr = _flash_backward(q, kr, vr, o, lse, do, causal=causal,
                                        block_k=block_k, segment_ids=seg_q,
-                                       kv_segment_ids=seg_kv)
+                                       kv_segment_ids=seg_kv_r)
         shape = k.shape[:-3] + (k.shape[-3], group) + k.shape[-2:]
         dk = dkr.astype(jnp.float32).reshape(shape).sum(axis=-3)
         dv = dvr.astype(jnp.float32).reshape(shape).sum(axis=-3)
@@ -950,6 +963,7 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
     if q.shape[:-2] != k.shape[:-2]:     # GQA on the jnp path: repeat kv
         _FlashDims(q.shape, k.shape, block_q, block_k)   # validates shapes
         group = q.shape[-3] // k.shape[-3]
+        kv_segment_ids = _repeat_kv_seg(kv_segment_ids, k, group)
         k = jnp.repeat(k, group, axis=-3)
         v = jnp.repeat(v, group, axis=-3)
     return blockwise_attention(q, k, v, causal=causal, block_k=block_k,

@@ -1,0 +1,101 @@
+"""Host-side document packing: variable-length token sequences → fixed-shape
+``(tokens, segment_ids, positions)`` batches for packed-attention training.
+
+This bridges the data layer (NGram/token pipelines emit variable-length
+documents; XLA wants static shapes) and the attention kernels'
+``segment_ids`` support (``ops/attention.py``): several documents share one
+sequence row, cross-document attention is masked, and positions restart per
+document so rotary embeddings see each document at offset 0.
+
+The reference has no packing (its TF/torch consumers tolerate ragged
+batches); this is TPU-native capability: pad-to-bucket wastes
+``(bucket − len)`` of every row, packing wastes only the final-row tail.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class PackedBatch(NamedTuple):
+    """``tokens`` (B, L); ``segment_ids`` (B, L) int32 — 0 marks padding,
+    documents count from 1 per row; ``positions`` (B, L) int32 — restart at 0
+    on every document boundary."""
+    tokens: jnp.ndarray
+    segment_ids: jnp.ndarray
+    positions: jnp.ndarray
+
+
+def pack_documents(docs: Sequence[Sequence[int]], seq_len: int, *,
+                   pad_token: int = 0, dtype=np.int32,
+                   num_rows: 'Optional[int]' = None) -> PackedBatch:
+    """Greedy first-fit packing (documents in order, each placed into the
+    first row with room — deterministic, so resumable pipelines re-produce
+    identical batches).
+
+    Every document must fit a row: ``len(doc) <= seq_len`` (split longer
+    documents upstream — the NGram window assembler already bounds window
+    length).
+
+    ``num_rows`` pins the batch dimension for jitted consumers: the output
+    is padded with all-padding rows up to ``num_rows`` (and packing raises
+    if the documents need more). Without it the row count is data-dependent
+    — fine eagerly, but every distinct count retraces a jitted train step,
+    so streaming pipelines should always pass it.
+    """
+    rows: List[List[Sequence[int]]] = []
+    space: List[int] = []
+    for doc in docs:
+        n = len(doc)
+        if n == 0:
+            raise ValueError('cannot pack an empty document')
+        if n > seq_len:
+            raise ValueError('document of length %d exceeds seq_len=%d; '
+                             'split it upstream' % (n, seq_len))
+        for i, free in enumerate(space):
+            if free >= n:
+                rows[i].append(doc)
+                space[i] -= n
+                break
+        else:
+            rows.append([doc])
+            space.append(seq_len - n)
+
+    if num_rows is not None:
+        if len(rows) > num_rows:
+            raise ValueError(
+                'documents need %d rows but num_rows=%d; feed fewer '
+                'documents per batch' % (len(rows), num_rows))
+        rows.extend([[] for _ in range(num_rows - len(rows))])
+    b = len(rows)
+    tokens = np.full((b, seq_len), pad_token, dtype=dtype)
+    segment_ids = np.zeros((b, seq_len), dtype=np.int32)
+    positions = np.zeros((b, seq_len), dtype=np.int32)
+    for i, row_docs in enumerate(rows):
+        cursor = 0
+        for seg, doc in enumerate(row_docs, start=1):
+            n = len(doc)
+            tokens[i, cursor:cursor + n] = np.asarray(doc, dtype=dtype)
+            segment_ids[i, cursor:cursor + n] = seg
+            positions[i, cursor:cursor + n] = np.arange(n)
+            cursor += n
+    return PackedBatch(jnp.asarray(tokens), jnp.asarray(segment_ids),
+                       jnp.asarray(positions))
+
+
+def packed_lm_targets(tokens, segment_ids):
+    """Next-token targets and loss weights for a packed batch: weight 1 where
+    the current AND next slot belong to the same (nonzero) document — the
+    last token of each document and all padding get weight 0, so no document
+    is trained to predict its neighbor's first token."""
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1)
+    next_seg = jnp.concatenate(
+        [segment_ids[:, 1:], jnp.zeros_like(segment_ids[:, :1])], axis=1)
+    weights = ((segment_ids > 0)
+               & (segment_ids == next_seg)).astype(jnp.float32)
+    return targets, weights

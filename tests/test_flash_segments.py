@@ -84,6 +84,38 @@ class TestSegmentMasking:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-3, rtol=1e-3)
 
+    @pytest.mark.parametrize('backend', ['jnp', 'interpret'])
+    def test_gqa_per_kv_head_segment_ids(self, cpu, backend):
+        """Per-kv-head kv_segment_ids must survive the jnp fallback's
+        head-repeat (forward AND the bwd='jnp' oracle)."""
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.standard_normal((1, 4, 64, 16)), jnp.float32)
+        k, v = (jnp.asarray(rng.standard_normal((1, 2, 64, 16)), jnp.float32)
+                for _ in range(2))
+        seg = jnp.asarray(np.repeat([0, 1], [30, 34]), jnp.int32)[None]
+        seg_kv = jnp.broadcast_to(seg[:, None, :], (1, 2, 64))
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, block_q=64, block_k=64,
+                backend=backend, segment_ids=seg,
+                kv_segment_ids=seg_kv,
+                **({'bwd': 'jnp'} if backend == 'interpret' else {})) ** 2)
+
+        def loss_ref(q, k, v):
+            kr, vr = jnp.repeat(k, 2, -3), jnp.repeat(v, 2, -3)
+            return jnp.sum(blockwise_attention(
+                q, kr, vr, causal=True, block_k=64,
+                segment_ids=seg) ** 2)
+
+        gp = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        # the repeat is inside loss_ref, so its grads already carry kv shapes
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        assert gp[1].shape == k.shape
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-3, rtol=1e-3)
+
     def test_segments_with_gqa(self, cpu):
         lens = (40, 88)
         q, _, _, seg, lens = _packed(2, 4, lens, 32)
