@@ -79,10 +79,31 @@ def train(dataset_url, steps=20, mesh=None):
             if len(losses) >= steps:
                 break
     print('first loss {:.3f} -> last loss {:.3f}'.format(losses[0], losses[-1]))
-    return losses
+    return losses, params, config
+
+
+def sample(params, config, prompt_len=8, max_new_tokens=32, temperature=0.8,
+           top_p=0.9, seed=0):
+    """Continue a prompt with the trained model (KV-cache decode, nucleus
+    sampling). Returns the sampled (1, max_new_tokens) continuation."""
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.models import transformer_lm as tlm
+
+    rng = np.random.default_rng(seed)
+    prompt = jnp.asarray(
+        rng.integers(0, config.vocab_size, (1, prompt_len)), jnp.int32)
+    out = tlm.generate(params, prompt, config, max_new_tokens,
+                       temperature=temperature, top_p=top_p,
+                       rng=jax.random.PRNGKey(seed))
+    print('prompt {} -> continuation {}'.format(
+        np.asarray(prompt)[0][:8], np.asarray(out)[0][:8]))
+    return out
 
 
 if __name__ == '__main__':
     url = 'file://' + tempfile.mkdtemp() + '/tokens'
     generate_token_stream(url)
-    train(url)
+    _, params, config = train(url)
+    sample(params, config)

@@ -496,13 +496,46 @@ def _decode_layer(x, layer, config: TransformerConfig, cache, index):
     return x, {'k': ck, 'v': cv}
 
 
+_NEG_INF_LOGIT = -1e30
+
+
+def _sample_logits(logits, temperature: float, top_k, top_p, rng):
+    """One sampling step over ``(B, vocab)`` float32 logits. temperature 0 =
+    greedy; otherwise categorical after optional top-k truncation and
+    top-p (nucleus) truncation — the smallest set of tokens whose
+    probabilities sum to ≥ top_p."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None or top_p is not None:
+        # one descending sort serves both truncations (this runs inside the
+        # scanned per-token decode loop)
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        if top_k is not None:
+            kth = sorted_desc[..., top_k - 1, None]
+            logits = jnp.where(logits < kth, _NEG_INF_LOGIT, logits)
+            sorted_desc = jnp.where(
+                jnp.arange(sorted_desc.shape[-1]) < top_k, sorted_desc,
+                _NEG_INF_LOGIT)
+        if top_p is not None:
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
+            kept = exclusive_cum < top_p        # always keeps the top token
+            threshold = jnp.min(jnp.where(kept, sorted_desc, jnp.inf),
+                                axis=-1, keepdims=True)
+            logits = jnp.where(logits >= threshold, logits, _NEG_INF_LOGIT)
+    return jax.random.categorical(rng, logits)
+
+
 def generate(params, tokens, config: TransformerConfig, max_new_tokens: int,
-             *, temperature: float = 0.0, rng=None):
+             *, temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None, rng=None):
     """Autoregressive decoding with per-layer KV caches.
 
     ``tokens`` ``(B, Lp)`` int32 prompts (same length across the batch) →
     ``(B, max_new_tokens)`` sampled continuations. ``temperature`` 0 =
-    greedy argmax, > 0 = categorical sampling (seeded by ``rng``). The
+    greedy argmax, > 0 = categorical sampling (seeded by ``rng``) with
+    optional ``top_k`` / ``top_p`` (nucleus) truncation. The
     prompt is prefilled through the same single-token decode path, so
     prefill and decode are numerically identical; works for dense, MoE, and
     GQA configs (the cache carries ``kv_heads`` heads). The config's
@@ -514,6 +547,10 @@ def generate(params, tokens, config: TransformerConfig, max_new_tokens: int,
     c = config
     b, prompt_len = tokens.shape
     total = prompt_len + max_new_tokens
+    if top_k is not None and not 1 <= top_k <= c.vocab_size:
+        raise ValueError('top_k must be in [1, vocab_size]')
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError('top_p must be in (0, 1]')
     if rng is None:
         rng = jax.random.PRNGKey(0)
     caches = init_kv_cache(c, b, total)
@@ -531,11 +568,8 @@ def generate(params, tokens, config: TransformerConfig, max_new_tokens: int,
         logits = (x @ params['unembed'].astype(c.dtype))[:, 0].astype(
             jnp.float32)
         rng, sub = jax.random.split(rng)
-        if temperature == 0.0:
-            nxt = jnp.argmax(logits, axis=-1).astype(buf.dtype)
-        else:
-            nxt = jax.random.categorical(
-                sub, logits / temperature).astype(buf.dtype)
+        nxt = _sample_logits(logits, temperature, top_k, top_p,
+                             sub).astype(buf.dtype)
         # keep prompt tokens during prefill; write samples after it
         buf = buf.at[:, t + 1].set(
             jnp.where(t + 1 < prompt_len, buf[:, t + 1], nxt))

@@ -42,9 +42,15 @@ class TestMnist:
 
 
 class TestTransformerLm:
-    def test_loss_decreases(self, tmp_path):
-        from examples.transformer_lm.main import generate_token_stream, train
+    def test_loss_decreases_and_samples(self, tmp_path):
+        import numpy as np
+        from examples.transformer_lm.main import (generate_token_stream,
+                                                  sample, train)
         url = 'file://' + str(tmp_path / 'tokens')
         generate_token_stream(url, n_steps=256)
-        losses = train(url, steps=12)
+        losses, params, config = train(url, steps=12)
         assert losses[-1] < losses[0]
+        out = sample(params, config, max_new_tokens=16)
+        arr = np.asarray(out)
+        assert arr.shape == (1, 16)
+        assert arr.min() >= 0 and arr.max() < config.vocab_size

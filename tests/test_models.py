@@ -204,6 +204,44 @@ class TestGenerate:
         assert not np.array_equal(np.asarray(g1), np.asarray(g3))
         assert np.asarray(g1).min() >= 0 and np.asarray(g1).max() < 64
 
+    def test_top_k1_and_tiny_top_p_equal_greedy(self, cpus):
+        """top_k=1 and a near-zero top_p both collapse sampling to the
+        argmax token regardless of temperature."""
+        from petastorm_tpu.models import transformer_lm as tlm
+        cfg = _tiny_config()
+        with jax.default_device(cpus[0]):
+            params = tlm.init(jax.random.PRNGKey(2), cfg)
+            prompt = jnp.zeros((2, 3), jnp.int32)
+            greedy = tlm.generate(params, prompt, cfg, 8)
+            k1 = tlm.generate(params, prompt, cfg, 8, temperature=1.0,
+                              top_k=1, rng=jax.random.PRNGKey(0))
+            p_tiny = tlm.generate(params, prompt, cfg, 8, temperature=1.0,
+                                  top_p=1e-9, rng=jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+        np.testing.assert_array_equal(np.asarray(p_tiny), np.asarray(greedy))
+
+    def test_top_p_one_equals_plain_sampling(self, cpus):
+        from petastorm_tpu.models import transformer_lm as tlm
+        cfg = _tiny_config()
+        with jax.default_device(cpus[0]):
+            params = tlm.init(jax.random.PRNGKey(2), cfg)
+            prompt = jnp.zeros((2, 3), jnp.int32)
+            plain = tlm.generate(params, prompt, cfg, 8, temperature=1.0,
+                                 rng=jax.random.PRNGKey(5))
+            p1 = tlm.generate(params, prompt, cfg, 8, temperature=1.0,
+                              top_p=1.0, rng=jax.random.PRNGKey(5))
+        np.testing.assert_array_equal(np.asarray(plain), np.asarray(p1))
+
+    def test_bad_sampling_params_rejected(self, cpus):
+        from petastorm_tpu.models import transformer_lm as tlm
+        cfg = _tiny_config()
+        params = tlm.init(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((1, 2), jnp.int32)
+        with pytest.raises(ValueError, match='top_k'):
+            tlm.generate(params, prompt, cfg, 2, temperature=1.0, top_k=0)
+        with pytest.raises(ValueError, match='top_p'):
+            tlm.generate(params, prompt, cfg, 2, temperature=1.0, top_p=1.5)
+
     def test_generate_jits(self, cpus):
         from petastorm_tpu.models import transformer_lm as tlm
         cfg = _tiny_config()
