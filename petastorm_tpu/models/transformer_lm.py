@@ -61,6 +61,10 @@ class TransformerConfig:
     # 'ring' shards attention over the 'seq' mesh axis; 'flash'/'blockwise'
     # compute full attention locally (XLA all-gathers kv if seq is sharded).
     attention: str = 'blockwise'
+    # sliding-window size: each token attends only the previous N positions
+    # ('flash'/'blockwise' training and the KV-cache decode honor it; not
+    # supported with 'ring').
+    attention_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -239,14 +243,19 @@ def _attention(x, layer, config: TransformerConfig, positions, mesh=None,
             raise ValueError('packed segment_ids are not supported with '
                              "attention='ring' (use 'flash'/'blockwise', or "
                              'shard unpacked sequences)')
+        if c.attention_window is not None:
+            raise ValueError('attention_window is not supported with '
+                             "attention='ring'")
         if mesh is None or 'seq' not in mesh.axis_names:
             raise ValueError("attention='ring' needs a mesh with a 'seq' axis")
         o = _ring_attention_sharded(q, k, v, mesh)
     elif c.attention == 'flash':
-        o = flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
+        o = flash_attention(q, k, v, causal=True, segment_ids=segment_ids,
+                            window=c.attention_window)
     else:
         o = blockwise_attention(q, k, v, causal=True,
-                                segment_ids=segment_ids)
+                                segment_ids=segment_ids,
+                                window=c.attention_window)
     o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, l, h * dh)
     return o @ layer['wo'].astype(x.dtype)
 
@@ -445,18 +454,22 @@ def init_kv_cache(config: TransformerConfig, batch_size: int, max_len: int):
             for _ in range(c.n_layers)]
 
 
-def _attend_cache(q, ck, cv, index):
+def _attend_cache(q, ck, cv, index, window=None):
     """One-token attention against the cache: q ``(B, H, 1, dh)``, cache
-    ``(B, Hkv, max, dh)``; positions > ``index`` are masked. GQA-aware (q
-    head groups share a cache head)."""
+    ``(B, Hkv, max, dh)``; positions > ``index`` (and, with ``window``,
+    positions ≤ index − window) are masked. GQA-aware (q head groups share a
+    cache head)."""
     b, h, _, dh = q.shape
     hkv = ck.shape[1]
     g = h // hkv
     qg = q.reshape(b, hkv, g, dh)
     s = jnp.einsum('bkgd,bkld->bkgl', qg.astype(jnp.float32),
                    ck.astype(jnp.float32)) / math.sqrt(dh)
-    mask = jnp.arange(ck.shape[2])[None, None, None, :] <= index
-    s = jnp.where(mask, s, -1e30)
+    pos = jnp.arange(ck.shape[2])[None, None, None, :]
+    mask = pos <= index
+    if window is not None:
+        mask = mask & (index - pos < window)
+    s = jnp.where(mask, s, _NEG_INF_LOGIT)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum('bkgl,bkld->bkgd', p, cv.astype(jnp.float32))
     return o.reshape(b, h, 1, dh).astype(q.dtype)
@@ -483,7 +496,7 @@ def _decode_layer(x, layer, config: TransformerConfig, cache, index):
         cache['k'], k_new.astype(cache['k'].dtype), (0, 0, index, 0))
     cv = jax.lax.dynamic_update_slice(
         cache['v'], v_new.astype(cache['v'].dtype), (0, 0, index, 0))
-    att = _attend_cache(q, ck, cv, index)
+    att = _attend_cache(q, ck, cv, index, window=c.attention_window)
     x = x + (jnp.transpose(att, (0, 2, 1, 3)).reshape(b, 1, h * dh)
              @ layer['wo'].astype(x.dtype))
 
@@ -547,6 +560,9 @@ def generate(params, tokens, config: TransformerConfig, max_new_tokens: int,
     c = config
     b, prompt_len = tokens.shape
     total = prompt_len + max_new_tokens
+    if c.attention_window is not None and c.attention_window < 1:
+        raise ValueError('attention_window must be >= 1, got %r'
+                         % (c.attention_window,))
     if top_k is not None and not 1 <= top_k <= c.vocab_size:
         raise ValueError('top_k must be in [1, vocab_size]')
     if top_p is not None and not 0.0 < top_p <= 1.0:

@@ -98,12 +98,16 @@ def _from_kv_blocks(xb, num_blocks: int, block_k: int):
     return jnp.moveaxis(xb, 0, -2)
 
 
-def _kv_block_mask(q_pos, blk_idx, block_k: int, kv_len: int, causal: bool):
-    """(Lq, bk) validity mask for one kv block: tail padding + causality."""
+def _kv_block_mask(q_pos, blk_idx, block_k: int, kv_len: int, causal: bool,
+                   window=None):
+    """(Lq, bk) validity mask for one kv block: tail padding + causality +
+    optional sliding window (attend only the last ``window`` positions)."""
     k_pos = blk_idx * block_k + jnp.arange(block_k)
     mask = jnp.broadcast_to(k_pos[None, :] < kv_len, (q_pos.shape[0], block_k))
     if causal:
         mask = mask & (q_pos[:, None] >= k_pos[None, :])
+    if window is not None:
+        mask = mask & (q_pos[:, None] - k_pos[None, :] < window)
     return mask
 
 
@@ -160,6 +164,19 @@ def _repeat_kv_seg(kv_seg, k, group: int):
     return kv_seg
 
 
+def _check_window(window, causal: bool):
+    """Sliding-window attention is defined here as Mistral-style: each token
+    attends the previous ``window`` positions, which only makes sense under
+    causal masking."""
+    if window is None:
+        return
+    if not causal:
+        raise ValueError('window requires causal=True (sliding-window '
+                         'attention looks back, not around)')
+    if window < 1:
+        raise ValueError('window must be >= 1, got %r' % (window,))
+
+
 def _resolve_segs(segment_ids, kv_segment_ids, q_ndim: int, k_ndim: int,
                   q_len: int, kv_len: int):
     """ONE definition of segment-argument semantics for every path (jnp
@@ -178,14 +195,17 @@ def _resolve_segs(segment_ids, kv_segment_ids, q_ndim: int, k_ndim: int,
 
 
 def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512,
-                        segment_ids=None, kv_segment_ids=None):
+                        segment_ids=None, kv_segment_ids=None, window=None):
     """Memory-efficient attention: scan over key/value blocks with online
     softmax. Works on any backend; O(L·block_k) live memory per head.
 
     Shapes: q/k/v ``(..., L, D)``; returns ``(..., L, D)`` in q's dtype.
     ``segment_ids`` ``(..., Lq)`` restricts attention to same-segment pairs
     (packed sequences); ``kv_segment_ids`` defaults to ``segment_ids``.
+    ``window`` restricts each token to the last ``window`` positions
+    (sliding-window/local attention; requires ``causal=True``).
     """
+    _check_window(window, causal)
     orig_dtype = q.dtype
     q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -206,11 +226,13 @@ def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512,
         o, m, l = carry
         if segment_ids is not None:
             k_blk, v_blk, seg_blk, blk_idx = inputs
-            mask = (_kv_block_mask(q_pos, blk_idx, block_k, k_len, causal)
+            mask = (_kv_block_mask(q_pos, blk_idx, block_k, k_len, causal,
+                                   window)
                     & _segment_mask(seg_q, seg_blk))
         else:
             k_blk, v_blk, blk_idx = inputs
-            mask = _kv_block_mask(q_pos, blk_idx, block_k, k_len, causal)
+            mask = _kv_block_mask(q_pos, blk_idx, block_k, k_len, causal,
+                                  window)
         o, m, l = _block_update(q32, k_blk, v_blk, o, m, l, scale, mask)
         return (o, m, l), None
 
@@ -226,7 +248,8 @@ def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512,
 
 def _flash_kernel(q_ref, k_ref, v_ref, *refs, block_q: int,
                   block_k: int, causal: bool, scale: float, kv_seq_len: int,
-                  num_kv_blocks: int, with_lse: bool, segmented: bool = False):
+                  num_kv_blocks: int, with_lse: bool, segmented: bool = False,
+                  window=None):
     """One (batch·head, q-block, kv-block) grid step.
 
     KV **streams through the grid**: each program sees only a (block_k, D)
@@ -260,8 +283,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, *refs, block_q: int,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     if causal:
-        # Skip kv blocks strictly above the causal diagonal for this q block.
+        # Skip kv blocks strictly above the causal diagonal for this q block;
+        # a sliding window additionally skips blocks entirely behind it.
         needed = kv_idx * block_k <= (q_idx + 1) * block_q - 1
+        if window is not None:
+            needed &= (kv_idx + 1) * block_k - 1 >= q_idx * block_q - window + 1
     else:
         needed = kv_idx >= 0
 
@@ -279,6 +305,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, *refs, block_q: int,
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, 1), 0)
             mask = mask & (q_pos >= k_pos)
+            if window is not None:
+                mask = mask & (q_pos - k_pos < window)
         if segmented:
             # segq (bq, 1); segkv stored sublane-replicated (8, bk)
             mask = mask & (segq_ref[...] == segkv_ref[0:1, :])
@@ -454,7 +482,7 @@ class _FlashDims:
 
 def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
                   interpret: bool = False, with_lse: bool = True,
-                  segment_ids=None, kv_segment_ids=None):
+                  segment_ids=None, kv_segment_ids=None, window=None):
     """Returns ``(o, lse)`` with o in q's dtype and lse float32 ``(..., Lq)``
     — lse is None when ``with_lse=False`` (the no-grad forward skips the
     lane-replicated lse write entirely). Non-block-divisible lengths are
@@ -492,7 +520,7 @@ def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(
         _flash_kernel, block_q=bq, block_k=bk, causal=causal, scale=scale,
         kv_seq_len=kv_len, num_kv_blocks=num_kv_blocks, with_lse=with_lse,
-        segmented=segmented)
+        segmented=segmented, window=window)
     vma = _out_vma(q, k, v)
     out_specs = [pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0))]
     out_shape = [_sds((flat, pq_len, head_dim), q.dtype, vma)]
@@ -523,7 +551,7 @@ def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
 
 def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_k: int,
                     scale: Optional[float] = None, segment_ids=None,
-                    kv_segment_ids=None):
+                    kv_segment_ids=None, window=None):
     """Memory-efficient flash backward (any backend): scan over kv blocks,
     recomputing p from (q, k, lse); O(Lq·block_k) live memory.
 
@@ -550,11 +578,12 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_k: int,
     def step(dq, inputs):
         if segment_ids is not None:
             k_blk, v_blk, seg_blk, blk_idx = inputs
-            mask = (_kv_block_mask(q_pos, blk_idx, bk, kv_len, causal)
+            mask = (_kv_block_mask(q_pos, blk_idx, bk, kv_len, causal,
+                                   window)
                     & _segment_mask(seg_q, seg_blk))
         else:
             k_blk, v_blk, blk_idx = inputs
-            mask = _kv_block_mask(q_pos, blk_idx, bk, kv_len, causal)
+            mask = _kv_block_mask(q_pos, blk_idx, bk, kv_len, causal, window)
         s = jnp.einsum('...qd,...kd->...qk', q32, k_blk) * scale
         p = jnp.exp(s - lse[..., None])
         p = jnp.where(jnp.broadcast_to(mask, p.shape), p, 0.0)
@@ -578,7 +607,7 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_k: int,
 def _bwd_recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
                         q_idx, kv_idx, block_q: int, block_k: int,
                         causal: bool, scale: float, kv_seq_len: int,
-                        segq_ref=None, segkv_ref=None):
+                        segq_ref=None, segkv_ref=None, window=None):
     """Shared recomputation block of both backward kernels: rebuild the
     probabilities p = exp(s − lse) for one (q-block, kv-block) tile (masking
     kv tail padding, causality, and — when segment refs are given — packed
@@ -601,6 +630,8 @@ def _bwd_recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
         q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, 1), 0)
         mask = mask & (q_pos >= k_pos)
+        if window is not None:
+            mask = mask & (q_pos - k_pos < window)
     if segq_ref is not None:
         mask = mask & (segq_ref[...] == segkv_ref[0:1, :])
     mask = jnp.broadcast_to(mask, s.shape)
@@ -615,7 +646,8 @@ def _bwd_recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          *refs, block_q: int, block_k: int,
                          causal: bool, scale: float, kv_seq_len: int,
-                         num_kv_blocks: int, segmented: bool = False):
+                         num_kv_blocks: int, segmented: bool = False,
+                         window=None):
     """dq pass: one (batch·head, q-block, kv-block) grid step; kv streams
     through the grid (like the forward), dq accumulates in VMEM scratch across
     the sequential kv dimension and is written on the final kv step.
@@ -638,6 +670,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         needed = kv_idx * block_k <= (q_idx + 1) * block_q - 1
+        if window is not None:
+            needed &= (kv_idx + 1) * block_k - 1 >= q_idx * block_q - window + 1
     else:
         needed = kv_idx >= 0
 
@@ -647,7 +681,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_idx=q_idx,
             kv_idx=kv_idx, block_q=block_q, block_k=block_k, causal=causal,
             scale=scale, kv_seq_len=kv_seq_len, segq_ref=segq_ref,
-            segkv_ref=segkv_ref)
+            segkv_ref=segkv_ref, window=window)
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -661,7 +695,7 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                            *refs, block_q: int,
                            block_k: int, causal: bool, scale: float,
                            kv_seq_len: int, num_q_blocks: int,
-                           segmented: bool = False):
+                           segmented: bool = False, window=None):
     """dk/dv pass: one (batch·head, kv-block, q-block) grid step; q (and do,
     lse, Δ) stream through the grid, dk/dv accumulate in VMEM scratch across
     the sequential q dimension. Padded q rows carry do == 0, so they
@@ -683,6 +717,8 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         needed = (q_idx + 1) * block_q - 1 >= kv_idx * block_k
+        if window is not None:
+            needed &= (kv_idx + 1) * block_k - 1 >= q_idx * block_q - window + 1
     else:
         needed = q_idx >= 0
 
@@ -692,7 +728,7 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_idx=q_idx,
             kv_idx=kv_idx, block_q=block_q, block_k=block_k, causal=causal,
             scale=scale, kv_seq_len=kv_seq_len, segq_ref=segq_ref,
-            segkv_ref=segkv_ref)
+            segkv_ref=segkv_ref, window=window)
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bk, D)
@@ -718,7 +754,8 @@ def _prepare_flash_bwd_q_side(dims: '_FlashDims', q, o, lse, do):
 
 def _pallas_flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
                            block_k: int, interpret: bool = False,
-                           segment_ids=None, kv_segment_ids=None):
+                           segment_ids=None, kv_segment_ids=None,
+                           window=None):
     """Fused flash backward: two Pallas kernels (dq; dk/dv), both streaming
     the non-owned operand through the grid — bounded VMEM at any length, like
     the forward. Returns (dq, dk, dv) in the input dtypes.
@@ -735,12 +772,13 @@ def _pallas_flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
         dims.check_segment_blocks(interpret)
         segs = (dims.pad_seg_q(seg_q), dims.pad_seg_kv(kv_seg))
     return _flash_backward_from_prepared(dims, prep, k, v, causal=causal,
-                                         interpret=interpret, segs=segs)
+                                         interpret=interpret, segs=segs,
+                                         window=window)
 
 
 def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
                                   causal: bool, interpret: bool = False,
-                                  segs=None):
+                                  segs=None, window=None):
     """Backward kernels given pre-padded q-side operands (see
     :func:`_prepare_flash_bwd_q_side`); only the kv chunk varies per call.
     ``segs``: optional pre-padded ``(seg_q, seg_kv)`` from ``pad_seg_q`` /
@@ -778,7 +816,8 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=bq, block_k=bk,
                           causal=causal, scale=scale, kv_seq_len=kv_len,
-                          num_kv_blocks=num_kv_blocks, segmented=segmented),
+                          num_kv_blocks=num_kv_blocks, segmented=segmented,
+                          window=window),
         grid=(flat, num_q_blocks, num_kv_blocks),
         in_specs=dq_specs,
         out_specs=qspec,
@@ -809,7 +848,8 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, block_q=bq, block_k=bk,
                           causal=causal, scale=scale, kv_seq_len=kv_len,
-                          num_q_blocks=num_q_blocks, segmented=segmented),
+                          num_q_blocks=num_q_blocks, segmented=segmented,
+                          window=window),
         grid=(flat, num_kv_blocks, num_q_blocks),
         in_specs=dkdv_specs,
         out_specs=[outspec_i, outspec_i],
@@ -869,29 +909,31 @@ def merge_attention_chunks(o_acc, m, l, o_i, lse_i):
     return o_acc, m_new, l * corr + w
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, seg_q, seg_kv, causal, block_q, block_k, interpret,
-           bwd_backend):
+           bwd_backend, window):
     o, _ = _pallas_flash(q, k, v, causal, block_q, block_k, interpret,
                          with_lse=False, segment_ids=seg_q,
-                         kv_segment_ids=seg_kv)
+                         kv_segment_ids=seg_kv, window=window)
     return o
 
 
 def _flash_fwd(q, k, v, seg_q, seg_kv, causal, block_q, block_k, interpret,
-               bwd_backend):
+               bwd_backend, window):
     o, lse = _pallas_flash(q, k, v, causal, block_q, block_k, interpret,
-                           segment_ids=seg_q, kv_segment_ids=seg_kv)
+                           segment_ids=seg_q, kv_segment_ids=seg_kv,
+                           window=window)
     return o, (q, k, v, o, lse, seg_q, seg_kv)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, bwd_backend, res, do):
+def _flash_bwd(causal, block_q, block_k, interpret, bwd_backend, window, res,
+               do):
     q, k, v, o, lse, seg_q, seg_kv = res
     if bwd_backend == 'pallas':
         grads = _pallas_flash_backward(q, k, v, o, lse, do, causal=causal,
                                        block_q=block_q, block_k=block_k,
                                        interpret=interpret, segment_ids=seg_q,
-                                       kv_segment_ids=seg_kv)
+                                       kv_segment_ids=seg_kv, window=window)
         return grads + (None, None)
     if q.shape[:-2] != k.shape[:-2]:     # GQA through the jnp oracle:
         group = q.shape[-3] // k.shape[-3]
@@ -900,14 +942,14 @@ def _flash_bwd(causal, block_q, block_k, interpret, bwd_backend, res, do):
         seg_kv_r = _repeat_kv_seg(seg_kv, k, group)
         dq, dkr, dvr = _flash_backward(q, kr, vr, o, lse, do, causal=causal,
                                        block_k=block_k, segment_ids=seg_q,
-                                       kv_segment_ids=seg_kv_r)
+                                       kv_segment_ids=seg_kv_r, window=window)
         shape = k.shape[:-3] + (k.shape[-3], group) + k.shape[-2:]
         dk = dkr.astype(jnp.float32).reshape(shape).sum(axis=-3)
         dv = dvr.astype(jnp.float32).reshape(shape).sum(axis=-3)
         return dq, dk.astype(k.dtype), dv.astype(v.dtype), None, None
     dq, dk, dv = _flash_backward(q, k, v, o, lse, do, causal=causal,
                                  block_k=block_k, segment_ids=seg_q,
-                                 kv_segment_ids=seg_kv)
+                                 kv_segment_ids=seg_kv, window=window)
     return dq, dk, dv, None, None
 
 
@@ -917,7 +959,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
                     block_k: int = 512, backend: Optional[str] = None,
                     bwd: Optional[str] = None, segment_ids=None,
-                    kv_segment_ids=None):
+                    kv_segment_ids=None, window: Optional[int] = None):
     """Fused attention over ``(..., L, D)`` inputs; differentiable (custom_vjp
     with fused Pallas backward kernels), any sequence length (padded to block
     multiples internally).
@@ -952,9 +994,11 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
         backend = 'pallas' if jax.default_backend() == 'tpu' else 'jnp'
     if bwd not in (None, 'pallas', 'jnp'):
         raise ValueError("bwd must be 'pallas' or 'jnp', got %r" % (bwd,))
+    _check_window(window, causal)
     if backend in ('pallas', 'interpret'):
         return _flash(q, k, v, segment_ids, kv_segment_ids, causal, block_q,
-                      block_k, backend == 'interpret', bwd or 'pallas')
+                      block_k, backend == 'interpret', bwd or 'pallas',
+                      window)
     if bwd is not None:
         raise ValueError("bwd applies only to the Pallas path (backend "
                          "'pallas' or 'interpret'); the %r backend "
@@ -968,4 +1012,4 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
         v = jnp.repeat(v, group, axis=-3)
     return blockwise_attention(q, k, v, causal=causal, block_k=block_k,
                                segment_ids=segment_ids,
-                               kv_segment_ids=kv_segment_ids)
+                               kv_segment_ids=kv_segment_ids, window=window)

@@ -1,0 +1,148 @@
+"""Sliding-window (local causal) attention across the stack: each token
+attends only the previous ``window`` positions. Contract: equals dense
+attention under an explicit band mask, composes with segments and GQA.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+jax.config.update('jax_default_matmul_precision', 'highest')
+
+from petastorm_tpu.ops.attention import blockwise_attention, flash_attention
+
+
+@pytest.fixture()
+def cpu():
+    with jax.default_device(jax.devices('cpu')[0]):
+        yield
+
+
+_RNG = np.random.default_rng(13)
+
+
+def _mk(b, h, l, d):
+    return tuple(jnp.asarray(_RNG.standard_normal((b, h, l, d)), jnp.float32)
+                 for _ in range(3))
+
+
+def _banded_reference(q, k, v, window):
+    """Dense softmax attention under an explicit causal band mask."""
+    d = q.shape[-1]
+    s = jnp.einsum('...qd,...kd->...qk', q, k) / np.sqrt(d)
+    lq, lk = q.shape[-2], k.shape[-2]
+    qpos, kpos = np.arange(lq)[:, None], np.arange(lk)[None, :]
+    mask = (qpos >= kpos) & (qpos - kpos < window)
+    s = jnp.where(mask, s, -1e30)
+    return jnp.einsum('...qk,...kd->...qd', jax.nn.softmax(s, -1), v)
+
+
+class TestWindow:
+    @pytest.mark.parametrize('backend', ['interpret', 'jnp'])
+    @pytest.mark.parametrize('l,window', [
+        (256, 64),                 # window == block size
+        (256, 100),                # window straddles blocks
+        (200, 17),                 # tiny window, padded length
+        (128, 1),                  # degenerate: attend self only
+    ])
+    def test_matches_banded_reference(self, cpu, backend, l, window):
+        q, k, v = _mk(2, 2, l, 32)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                              backend=backend, window=window)
+        ref = _banded_reference(q, k, v, window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_window_covering_length_equals_full_causal(self, cpu):
+        q, k, v = _mk(2, 2, 128, 32)
+        windowed = flash_attention(q, k, v, causal=True, block_q=64,
+                                   block_k=64, backend='interpret',
+                                   window=128)
+        full = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                               backend='interpret')
+        np.testing.assert_allclose(np.asarray(windowed), np.asarray(full),
+                                   atol=1e-6)
+
+    @pytest.mark.parametrize('bwd', ['pallas', 'jnp'])
+    @pytest.mark.parametrize('window', [64, 30])
+    def test_grads_match_banded_reference(self, cpu, window, bwd):
+        q, k, v = _mk(2, 2, 192, 32)
+
+        def loss_win(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, block_q=64, block_k=64,
+                backend='interpret', window=window, bwd=bwd) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_banded_reference(q, k, v, window) ** 2)
+
+        gw = jax.grad(loss_win, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gw, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-3, rtol=1e-3)
+
+    def test_window_with_segments(self, cpu):
+        """Window and packed segments compose: both constraints apply."""
+        q, k, v = _mk(1, 2, 128, 16)
+        seg = jnp.asarray(np.repeat([0, 1], [50, 78]), jnp.int32)[None]
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                              backend='interpret', segment_ids=seg, window=20)
+        # reference: band mask AND segment mask
+        d = q.shape[-1]
+        s = jnp.einsum('...qd,...kd->...qk', q, k) / np.sqrt(d)
+        pos = np.arange(128)
+        mask = ((pos[:, None] >= pos[None, :])
+                & (pos[:, None] - pos[None, :] < 20)
+                & (np.asarray(seg)[0][:, None] == np.asarray(seg)[0][None, :]))
+        ref = jnp.einsum('...qk,...kd->...qd',
+                         jax.nn.softmax(jnp.where(mask, s, -1e30), -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_window_with_gqa(self, cpu):
+        q, _, _ = _mk(1, 4, 128, 16)
+        k, v = (jnp.asarray(_RNG.standard_normal((1, 2, 128, 16)), jnp.float32)
+                for _ in range(2))
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                              backend='interpret', window=40)
+        ref = _banded_reference(q, jnp.repeat(k, 2, 1), jnp.repeat(v, 2, 1),
+                                40)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_validation(self, cpu):
+        q, k, v = _mk(1, 1, 32, 16)
+        with pytest.raises(ValueError, match='causal'):
+            flash_attention(q, k, v, causal=False, backend='interpret',
+                            window=8)
+        with pytest.raises(ValueError, match='window'):
+            flash_attention(q, k, v, causal=True, backend='interpret',
+                            window=0)
+        with pytest.raises(ValueError, match='causal'):
+            blockwise_attention(q, k, v, causal=False, window=8)
+
+
+@pytest.mark.skipif(jax.default_backend() != 'tpu',
+                    reason='needs real TPU hardware')
+class TestWindowTPU:
+    def test_window_on_hardware(self):
+        q, k, v = _mk(2, 4, 2048, 64)
+        window = 700
+        out = flash_attention(q, k, v, causal=True, backend='pallas',
+                              window=window)
+        ref = _banded_reference(q, k, v, window)
+        rel = float(jnp.max(jnp.abs(out - ref))) / float(jnp.max(jnp.abs(ref)))
+        assert rel < 1e-2, rel
+
+        gp = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, backend='pallas', window=window) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(
+            _banded_reference(q, k, v, window) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            rel = (float(jnp.max(jnp.abs(a - b)))
+                   / (float(jnp.max(jnp.abs(b))) + 1e-9))
+            assert rel < 1e-2, rel
