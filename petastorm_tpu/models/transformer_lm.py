@@ -233,8 +233,11 @@ def _attention(x, layer, config: TransformerConfig, positions, mesh=None,
     k = heads(layer['wk'], hkv)
     v = heads(layer['wv'], hkv)
     q, k = _rope(q, positions), _rope(k, positions)
-    if hkv != h and c.attention != 'flash':
-        # flash reads shared kv natively; the other paths repeat heads
+    if hkv != h and c.attention == 'blockwise':
+        # flash reads shared kv natively, and ring handles GQA itself
+        # (kernel head map on TPU — smaller rotating ppermute payloads —
+        # or an internal repeat on the jnp path); only blockwise needs the
+        # explicit head repeat here
         k = jnp.repeat(k, h // hkv, axis=1)
         v = jnp.repeat(v, h // hkv, axis=1)
 

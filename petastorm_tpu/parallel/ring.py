@@ -65,11 +65,23 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
     """
     impl = resolve_ring_impl(impl)
     if impl in ('pallas', 'interpret'):
+        # GQA (kv with fewer heads) flows through natively: the per-chunk
+        # flash kernels read shared kv via the head map, and fewer kv heads
+        # also shrink the rotating ppermute payload.
         return _ring_flash(q, k, v, axis_name, causal, block_q, block_k,
                            impl == 'interpret')
     if impl != 'jnp':
         raise ValueError("impl must be 'pallas', 'jnp' or 'interpret', "
                          "got %r" % (impl,))
+    if q.shape[:-2] != k.shape[:-2]:
+        # the jnp block update needs matching head counts; repeat kv here so
+        # both impls accept the same GQA inputs (the Pallas path stays the
+        # memory-efficient one). _FlashDims validates the head ratio with
+        # the same error the Pallas path raises.
+        _FlashDims(q.shape, k.shape, block_q, block_k)
+        group = q.shape[-3] // k.shape[-3]
+        k = jnp.repeat(k, group, axis=-3)
+        v = jnp.repeat(v, group, axis=-3)
     orig_dtype = q.dtype
     q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
     n = jax.lax.psum(1, axis_name)

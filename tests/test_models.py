@@ -276,6 +276,22 @@ class TestGenerate:
 
 
 class TestGroupedQueryAttention:
+    def test_gqa_ring_train_step(self, cpus):
+        """GQA composes with ring attention: kv chunks rotate with the
+        reduced head count (or the jnp path repeats internally)."""
+        from petastorm_tpu.models import transformer_lm as tlm
+        from petastorm_tpu.parallel import make_mesh
+        cfg = _tiny_config(n_kv_heads=2, attention='ring')
+        mesh = make_mesh({'data': 2, 'seq': 4},
+                         devices=jax.devices('cpu')[:8])
+        params = tlm.init(jax.random.PRNGKey(0), cfg)
+        opt, step = tlm.make_train_step(cfg, mesh)
+        st = opt.init(params)
+        rng = np.random.default_rng(0)
+        toks = jnp.asarray(rng.integers(0, 64, (4, 32)), jnp.int32)
+        params, st, loss = step(params, st, toks, jnp.roll(toks, -1, 1))
+        assert np.isfinite(float(loss))
+
     def test_gqa_train_step_and_kv_param_shapes(self, cpus):
         from petastorm_tpu.models import transformer_lm as tlm
         cfg = _tiny_config(n_kv_heads=2)     # 4 q heads over 2 kv heads

@@ -106,6 +106,46 @@ class TestRingAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4, rtol=1e-4)
 
+    @pytest.mark.parametrize('impl', ['jnp', 'interpret'])
+    def test_ring_gqa_matches_repeated_kv(self, cpus, impl):
+        """Ring attention accepts GQA inputs on both impls: the Pallas path
+        reads shared kv chunks via the head map, the jnp path head-repeats."""
+        from petastorm_tpu.parallel import make_mesh
+        from petastorm_tpu.parallel.ring import make_ring_attention
+        rng = np.random.default_rng(21)
+        q = jnp.asarray(rng.standard_normal((2, 4, 128, 32)), jnp.float32)
+        k, v = (jnp.asarray(rng.standard_normal((2, 2, 128, 32)), jnp.float32)
+                for _ in range(2))
+        mesh = make_mesh({'data': 2, 'seq': 4}, devices=cpus)
+        fn = make_ring_attention(mesh, 'seq', impl=impl)
+        out = fn(q, k, v)
+        with jax.default_device(cpus[0]):
+            ref = _ref_attention(q, jnp.repeat(k, 2, 1), jnp.repeat(v, 2, 1))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_ref_attention(
+                q, jnp.repeat(k, 2, 1), jnp.repeat(v, 2, 1)) ** 2)
+
+        gp = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        with jax.default_device(cpus[0]):
+            gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        assert gp[1].shape == k.shape
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_ring_gqa_bad_ratio_rejected(self, cpus):
+        from petastorm_tpu.parallel import make_mesh
+        from petastorm_tpu.parallel.ring import make_ring_attention
+        q = jnp.ones((2, 6, 64, 32))
+        k = jnp.ones((2, 4, 64, 32))
+        mesh = make_mesh({'seq': 8}, devices=cpus)
+        with pytest.raises(ValueError, match='multiple of kv heads'):
+            make_ring_attention(mesh, 'seq', impl='jnp')(q, k, k)
+
     def test_bad_impl_rejected(self, qkv, cpus):
         from petastorm_tpu.parallel import make_mesh
         from petastorm_tpu.parallel.ring import make_ring_attention
