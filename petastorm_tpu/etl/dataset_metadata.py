@@ -268,7 +268,8 @@ def _list_data_files(filesystem, dataset_path) -> List[str]:
 
 
 def load_row_groups(filesystem, dataset_path: str,
-                    num_discovery_workers: int = 8) -> List[RowGroupPiece]:
+                    num_discovery_workers: int = 8,
+                    footer_cache: Optional[Dict] = None) -> List[RowGroupPiece]:
     """Discover all row groups of a dataset as a deterministic piece list:
     sorted by (path, row_group) for directory datasets, caller's order for
     explicit file lists.
@@ -299,6 +300,10 @@ def load_row_groups(filesystem, dataset_path: str,
     def footer_row_groups(f: str) -> Tuple[str, int, List[int]]:
         with filesystem.open(f, 'rb') as fh:
             md = pq.ParquetFile(fh).metadata
+            if footer_cache is not None:
+                # callers (stats-based filter pruning) reuse the parsed
+                # footers instead of paying a second round-trip per file
+                footer_cache[f] = md
             return f, md.num_row_groups, [md.row_group(i).num_rows
                                           for i in range(md.num_row_groups)]
 

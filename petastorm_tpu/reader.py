@@ -26,14 +26,18 @@ from petastorm_tpu.codecs import build_decode_overrides
 from petastorm_tpu.errors import NoDataAvailableError, PetastormMetadataError
 from petastorm_tpu.etl.dataset_metadata import (get_schema, infer_or_load_unischema,
                                                 load_row_groups)
+from petastorm_tpu.filters import (FiltersPredicate, RowGroupStatsEvaluator,
+                                   filter_column_names, normalize_filters,
+                                   validate_filter_types)
 from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dataset_url_or_urls
 from petastorm_tpu.ngram import NGram
+from petastorm_tpu.predicates import in_reduce
 from petastorm_tpu.readers.batch_worker import ArrowBatchWorker, BatchResultsReader
 from petastorm_tpu.readers.columnar_worker import ColumnarResultsReader, ColumnarWorker
 from petastorm_tpu.readers.row_worker import RowGroupResultsReader, RowGroupWorker
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.unischema import match_unischema_fields
-from petastorm_tpu.utils import cast_partition_value, cast_string_to_type
+from petastorm_tpu.utils import cast_partition_value
 from petastorm_tpu.workers import EmptyResultError
 from petastorm_tpu.workers.dummy_pool import DummyPool
 from petastorm_tpu.workers.process_pool import ProcessPool
@@ -325,12 +329,14 @@ class Reader:
         self.schema = transformed_schema
 
         # -- row-group discovery + filtering (reference reader.py:498-608) -----
-        all_pieces = load_row_groups(filesystem, dataset_path)
+        footer_cache = {}
+        all_pieces = load_row_groups(filesystem, dataset_path,
+                                     footer_cache=footer_cache)
         if not all_pieces:
             raise NoDataAvailableError('No row groups found at {}'.format(dataset_path))
-        pieces, worker_predicate = self._filter_row_groups(
+        pieces, worker_predicate, filters_predicate = self._filter_row_groups(
             filesystem, all_pieces, stored_schema, predicate, rowgroup_selector,
-            filters, cur_shard, shard_count)
+            filters, cur_shard, shard_count, footer_cache)
         del all_pieces
         if not pieces:
             raise NoDataAvailableError(
@@ -341,9 +347,19 @@ class Reader:
         # -- ventilation (reference reader.py:622-637) -------------------------
         items = []
         for piece_index in range(len(pieces)):
+            piece_predicate = worker_predicate
+            if filters_predicate is not None:
+                specialized = filters_predicate.specialize(pieces[piece_index],
+                                                           stored_schema)
+                if specialized is not None:
+                    if piece_predicate is not None:
+                        piece_predicate = in_reduce(
+                            [piece_predicate, specialized], all)
+                    else:
+                        piece_predicate = specialized
             for drop_partition in range(shuffle_row_drop_partitions):
                 items.append({'piece_index': piece_index,
-                              'worker_predicate': worker_predicate,
+                              'worker_predicate': piece_predicate,
                               'shuffle_row_drop_partition': (
                                   drop_partition, shuffle_row_drop_partitions)})
         self._ventilator = ConcurrentVentilator(
@@ -376,18 +392,20 @@ class Reader:
     # -- filtering -------------------------------------------------------------
 
     def _filter_row_groups(self, filesystem, pieces, stored_schema, predicate,
-                           rowgroup_selector, filters, cur_shard, shard_count):
+                           rowgroup_selector, filters, cur_shard, shard_count,
+                           footer_cache=None):
         # Row-group indexes (rowgroup_selector) are built over the full
         # load_row_groups() ordering; carry each piece's original ordinal so
         # selection stays aligned after predicate/filters pruning.
         indexed = list(enumerate(pieces))
         worker_predicate = None
+        filters_predicate = None
+        partition_keys = (set(pieces[0].partition_dict.keys()) if pieces else set())
         if predicate is not None:
             predicate_fields = set(predicate.get_fields())
             unknown = predicate_fields - set(stored_schema.fields.keys())
             if unknown:
                 raise ValueError('Predicate uses unknown fields: {}'.format(sorted(unknown)))
-            partition_keys = (set(pieces[0].partition_dict.keys()) if pieces else set())
             if predicate_fields and predicate_fields <= partition_keys:
                 # Evaluate on partition values only: prune pieces with no reads
                 # (reference reader.py:577-608).
@@ -397,9 +415,34 @@ class Reader:
             else:
                 worker_predicate = predicate
 
-        if filters is not None:
-            indexed = [(i, p) for i, p in indexed if _piece_passes_filters(
-                p, filters, stored_schema)]
+        conjunctions = normalize_filters(filters) if filters is not None else None
+        if conjunctions:
+            filter_cols = set(filter_column_names(conjunctions))
+            # hive partition columns may be absent from the stored schema
+            unknown = filter_cols - set(stored_schema.fields.keys()) - partition_keys
+            if unknown:
+                raise ValueError('filters use unknown columns: {}'.format(
+                    sorted(unknown)))
+            validate_filter_types(conjunctions, stored_schema, partition_keys)
+            # Planning: exact on partition values, conservative on row-group
+            # min/max statistics (reference delegates both to pyarrow,
+            # reader.py:399-401). Pruning never decides inclusion on its own —
+            # any non-partition term also pushes the full DNF down to the
+            # workers so the result is row-exact. The partition-only pass runs
+            # first so footers are only fetched for pieces it cannot prune.
+            stats = RowGroupStatsEvaluator(filesystem, stored_schema,
+                                           preloaded_footers=footer_cache)
+            indexed = [(i, p) for i, p in indexed
+                       if stats.piece_maybe_matches(p, conjunctions,
+                                                    partition_only=True)]
+            if filter_cols - partition_keys:
+                stats.prefetch_footers({p.path for _, p in indexed})
+                indexed = [(i, p) for i, p in indexed
+                           if stats.piece_maybe_matches(p, conjunctions)]
+                # row-exact residual; specialized per piece at ventilation
+                # time (partition terms are constants for a given piece, and
+                # may name columns the stored schema doesn't even declare)
+                filters_predicate = FiltersPredicate(conjunctions)
 
         if rowgroup_selector is not None:
             from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
@@ -422,7 +465,7 @@ class Reader:
                     'shards were requested; some shards would receive no '
                     'data'.format(len(pieces), shard_count))
             pieces = [p for i, p in enumerate(pieces) if i % shard_count == cur_shard]
-        return pieces, worker_predicate
+        return pieces, worker_predicate, filters_predicate
 
     # -- iteration -------------------------------------------------------------
 
@@ -477,48 +520,3 @@ class Reader:
 def _cast_partition(schema, field_name, value):
     field = schema.fields.get(field_name)
     return cast_partition_value(field.numpy_dtype if field is not None else None, value)
-
-
-_FILTER_OPS = {
-    '=': lambda a, b: a == b,
-    '==': lambda a, b: a == b,
-    '!=': lambda a, b: a != b,
-    '<': lambda a, b: a < b,
-    '<=': lambda a, b: a <= b,
-    '>': lambda a, b: a > b,
-    '>=': lambda a, b: a >= b,
-    'in': lambda a, b: a in b,
-    'not in': lambda a, b: a not in b,
-}
-
-
-def _piece_passes_filters(piece, filters, schema) -> bool:
-    """pyarrow-style DNF filters evaluated on hive partition values
-    (reference passes ``filters`` into ``pq.ParquetDataset``, ``reader.py:399``).
-
-    ``filters`` is ``[(col, op, val), ...]`` (AND) or a list of such lists (OR).
-    """
-    if not filters:
-        return True
-    if isinstance(filters[0], tuple):
-        conjunctions = [filters]
-    else:
-        conjunctions = filters
-    values = piece.partition_dict
-    for conjunction in conjunctions:
-        ok = True
-        for col, op, val in conjunction:
-            if col not in values:
-                ok = False
-                break
-            actual = _cast_partition(schema, col, values[col])
-            # cast to the filter value's type when partition value is a string
-            if isinstance(actual, str) and not isinstance(val, str) \
-                    and not isinstance(val, (list, tuple, set)):
-                actual = cast_string_to_type(type(val), actual)
-            if not _FILTER_OPS[op](actual, val):
-                ok = False
-                break
-        if ok:
-            return True
-    return False

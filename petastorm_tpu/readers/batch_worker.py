@@ -15,7 +15,6 @@ import numpy as np
 import pyarrow as pa
 
 from petastorm_tpu.readers.piece_worker import ParquetPieceWorker
-from petastorm_tpu.utils import cast_partition_value
 
 
 class BatchResultsReader:
@@ -80,16 +79,16 @@ class ArrowBatchWorker(ParquetPieceWorker):
 
     # -- loading ---------------------------------------------------------------
 
-    def _append_partition_columns(self, table: pa.Table, piece) -> pa.Table:
-        for key, value in piece.partition_dict.items():
-            if key in self._schema.fields and key not in table.column_names:
-                field = self._schema.fields[key]
-                typed = cast_partition_value(field.numpy_dtype, value)
-                if field.numpy_dtype is str:
-                    arr = pa.array([typed] * table.num_rows, type=pa.string())
-                else:
-                    arr = pa.array(np.full(table.num_rows, typed))
-                table = table.append_column(key, arr)
+    def _append_partition_columns(self, table: pa.Table, piece,
+                                  extra_names=()) -> pa.Table:
+        """Synthesize hive-partition columns for the view schema plus any
+        ``extra_names`` (predicate/filter columns outside the view)."""
+        from petastorm_tpu.readers.columnar_worker import make_partition_columns
+        wanted = {k for k in set(self._schema.fields) | set(extra_names)
+                  if k not in table.column_names}
+        for key, col in make_partition_columns(self._full_schema, piece,
+                                               table.num_rows, wanted).items():
+            table = table.append_column(key, pa.array(col))
         return table
 
     def _load_table(self, piece) -> pa.Table:
@@ -103,14 +102,13 @@ class ArrowBatchWorker(ParquetPieceWorker):
         then read only the *remaining* columns and join them with the
         already-loaded predicate columns — each column is read exactly once
         (reference :229-288)."""
-        predicate_fields = predicate.get_fields()
-        unknown = set(predicate_fields) - set(self._schema.fields.keys())
-        if unknown:
-            raise ValueError('Predicate uses unknown fields: {}'.format(sorted(unknown)))
+        from petastorm_tpu.readers.columnar_worker import validate_predicate_fields
+        predicate_fields = validate_predicate_fields(predicate, self._full_schema)
         pf = self._parquet_file(piece.path)
         pred_stored = pf.read_row_group(
             piece.row_group, columns=self._stored_columns(predicate_fields, piece))
-        pred_table = self._append_partition_columns(pred_stored, piece)
+        pred_table = self._append_partition_columns(pred_stored, piece,
+                                                    extra_names=set(predicate_fields))
         pred_data = {name: pred_table.column(name).to_pylist() for name in predicate_fields}
         mask = [predicate.do_include({f: pred_data[f][i] for f in predicate_fields})
                 for i in range(pred_table.num_rows)]
