@@ -56,6 +56,10 @@ class TransformerConfig:
     # Switch-Transformer semantics).
     moe_capacity_factor: float = 1.25
     # Weight of the Switch load-balancing auxiliary loss; 0 disables it.
+    # Deviation from the GShard paper for top_k > 1: the dispatch fraction in
+    # the aux term counts ALL k choices per token, not just the first choice —
+    # this pressures the router to balance the full dispatch load (what the
+    # capacity buffers actually see) rather than first-choice load only.
     moe_aux_weight: float = 0.01
     dtype: Any = jnp.bfloat16
     # 'ring' shards attention over the 'seq' mesh axis; 'flash'/'blockwise'
@@ -304,7 +308,8 @@ def _moe_router(probs, k: int):
     return top_idx, top_probs
 
 
-def _moe_ffn(x, layer, config: TransformerConfig, mesh=None):
+def _moe_ffn(x, layer, config: TransformerConfig, mesh=None,
+             capacity: Optional[int] = None):
     """Top-k MoE with sort-based sparse dispatch (k=1: Switch; k>1: GShard).
 
     Every (token, choice) pair is one dispatch unit: units are stably sorted
@@ -327,8 +332,9 @@ def _moe_ffn(x, layer, config: TransformerConfig, mesh=None):
     unit_expert = top_idx.reshape(n_units)                   # unit u ↔ token u//k
     scale = top_probs.astype(x.dtype)                        # (N, k)
 
-    capacity = max(1, int(math.ceil(n_units / e
-                                    * config.moe_capacity_factor)))
+    if capacity is None:
+        capacity = max(1, int(math.ceil(n_units / e
+                                        * config.moe_capacity_factor)))
     # stable sort keeps same-expert units in stream order → deterministic
     # drop policy (earliest tokens win a contended expert)
     order = jnp.argsort(unit_expert, stable=True)
@@ -505,7 +511,11 @@ def _decode_layer(x, layer, config: TransformerConfig, cache, index):
 
     h2 = _rms_norm(x, layer['ln2'])
     if c.n_experts > 0:
-        ffn_out, _ = _moe_ffn(h2, layer, c)      # aux loss unused at decode
+        # capacity = all units of the step: per-step routing sees only B
+        # units (vs B·L at training), so the trained capacity_factor could
+        # silently drop choices and make decode diverge from teacher forcing
+        ffn_out, _ = _moe_ffn(h2, layer, c,
+                              capacity=b * c.moe_top_k)  # aux unused at decode
         x = x + ffn_out
     else:
         x = x + _dense_ffn(h2, layer)
@@ -524,22 +534,24 @@ def _sample_logits(logits, temperature: float, top_k, top_p, rng):
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k is not None or top_p is not None:
-        # one descending sort serves both truncations (this runs inside the
-        # scanned per-token decode loop)
-        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        # one descending argsort serves both truncations (this runs inside
+        # the scanned per-token decode loop). The keep-mask is built over
+        # sorted *ranks* and scattered back through the permutation —
+        # comparing against the k-th/threshold value would leak every token
+        # tied with the cutoff into the candidate set
+        order = jnp.argsort(logits, axis=-1)[..., ::-1]
+        sorted_desc = jnp.take_along_axis(logits, order, axis=-1)
+        keep = jnp.ones(sorted_desc.shape, bool)
         if top_k is not None:
-            kth = sorted_desc[..., top_k - 1, None]
-            logits = jnp.where(logits < kth, _NEG_INF_LOGIT, logits)
-            sorted_desc = jnp.where(
-                jnp.arange(sorted_desc.shape[-1]) < top_k, sorted_desc,
-                _NEG_INF_LOGIT)
+            keep &= jnp.arange(keep.shape[-1]) < top_k
         if top_p is not None:
-            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            probs = jax.nn.softmax(
+                jnp.where(keep, sorted_desc, _NEG_INF_LOGIT), axis=-1)
             exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
-            kept = exclusive_cum < top_p        # always keeps the top token
-            threshold = jnp.min(jnp.where(kept, sorted_desc, jnp.inf),
-                                axis=-1, keepdims=True)
-            logits = jnp.where(logits >= threshold, logits, _NEG_INF_LOGIT)
+            keep &= exclusive_cum < top_p       # always keeps the top token
+        inverse = jnp.argsort(order, axis=-1)
+        logits = jnp.where(jnp.take_along_axis(keep, inverse, axis=-1),
+                           logits, _NEG_INF_LOGIT)
     return jax.random.categorical(rng, logits)
 
 
@@ -556,10 +568,10 @@ def generate(params, tokens, config: TransformerConfig, max_new_tokens: int,
     prefill and decode are numerically identical; works for dense, MoE, and
     GQA configs (the cache carries ``kv_heads`` heads). The config's
     ``attention`` mode only affects training — decode always attends the
-    cache directly. MoE caveat: routing capacity is evaluated per decode
-    step (over B units, not B·L), so expert-overflow drops can differ from
-    the training forward — equivalence is exact only when no drops occur
-    (ample ``moe_capacity_factor``)."""
+    cache directly. MoE decode routes with capacity = all units of the step
+    (B·top_k), so per-step routing can never drop a choice and decode
+    matches teacher forcing for every config (training capacity_factor only
+    shapes the training-time drop policy)."""
     c = config
     b, prompt_len = tokens.shape
     total = prompt_len + max_new_tokens

@@ -133,20 +133,21 @@ def _normalize_seg(seg, target_ndim: int, length: int, name: str):
     head/batch axes until it broadcasts against ``(..., L)`` operands of
     ``target_ndim`` dims — callers pass ``(B, L)``, ``(L,)`` or the full
     per-head shape interchangeably. Ids must be non-negative (negative values
-    collide with the internal pad sentinels); checked when concrete."""
+    collide with the internal pad sentinels); checked only for host-side
+    inputs (lists/numpy) — validating a concrete on-device array would force
+    a device→host sync per layer per eager step, so device arrays and
+    tracers rely on the documented contract."""
+    host_side = not isinstance(seg, jax.Array)
+    if host_side:
+        import numpy as _np
+        if (_np.asarray(seg) < 0).any():
+            raise ValueError('%s must be non-negative (negative ids collide '
+                             'with internal padding sentinels)' % name)
     seg = jnp.asarray(seg)
     if seg.shape[-1] != length or seg.ndim > target_ndim:
         raise ValueError(
             '%s must have shape (..., %d) broadcastable over the attention '
             'operands; got %r' % (name, length, seg.shape))
-    try:
-        import numpy as _np
-        if (_np.asarray(seg) < 0).any():
-            raise ValueError('%s must be non-negative (negative ids collide '
-                             'with internal padding sentinels)' % name)
-    except (jax.errors.TracerArrayConversionError,
-            jax.errors.ConcretizationTypeError):
-        pass          # traced inside jit: contract documented, not checkable
     while seg.ndim < target_ndim:
         seg = seg[..., None, :]
     return seg

@@ -152,12 +152,20 @@ class TestSegmentMasking:
             flash_attention(q, k, v, backend=backend, kv_segment_ids=seg)
 
     @pytest.mark.parametrize('backend', ['interpret', 'jnp'])
-    def test_negative_segment_ids_rejected(self, cpu, backend):
-        """Negative ids collide with the internal pad sentinels."""
+    def test_negative_segment_ids_rejected_host_side(self, cpu, backend):
+        """Negative ids collide with the internal pad sentinels. The check
+        runs only for host-side (numpy/list) inputs — validating a concrete
+        device array would force a device→host sync per layer per eager call
+        (round-3 advisor finding), so device arrays rely on the documented
+        contract."""
         q, k, v, seg, _ = _packed(2, 2, (32, 32), 16)
-        bad = seg.at[:, 0].set(-2)
+        bad_host = np.asarray(seg.at[:, 0].set(-2))
         with pytest.raises(ValueError, match='non-negative'):
-            flash_attention(q, k, v, backend=backend, segment_ids=bad)
+            flash_attention(q, k, v, backend=backend, segment_ids=bad_host)
+        # device arrays skip the value check by design (no host sync); the
+        # call must still run without error
+        flash_attention(q, k, v, backend=backend,
+                        segment_ids=seg.at[:, 0].set(-2))
 
 
 @pytest.mark.skipif(jax.default_backend() != 'tpu',

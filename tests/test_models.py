@@ -232,6 +232,57 @@ class TestGenerate:
                               top_p=1.0, rng=jax.random.PRNGKey(5))
         np.testing.assert_array_equal(np.asarray(plain), np.asarray(p1))
 
+    def test_top_k_ties_keep_exactly_k(self, cpus):
+        """Tokens tied with the k-th logit must not leak into the candidate
+        set: rank-based masking keeps exactly k (value-comparison masking
+        kept every tied token)."""
+        from petastorm_tpu.models import transformer_lm as tlm
+        logits = jnp.asarray([[1.0, 1.0, 1.0, 0.0, -1.0]])
+        seen = set()
+        with jax.default_device(cpus[0]):
+            for s in range(60):
+                tok = tlm._sample_logits(logits, 1.0, 2, None,
+                                         jax.random.PRNGKey(s))
+                seen.add(int(tok[0]))
+        assert len(seen) == 2 and seen <= {0, 1, 2}
+
+    def test_top_p_ties_keep_minimal_set(self, cpus):
+        """Uniform logits: exclusive-cumsum nucleus with top_p=0.5 keeps
+        exactly the first two ranks; ties at the threshold must not widen
+        the set."""
+        from petastorm_tpu.models import transformer_lm as tlm
+        logits = jnp.zeros((1, 4))
+        seen = set()
+        with jax.default_device(cpus[0]):
+            for s in range(80):
+                tok = tlm._sample_logits(logits, 1.0, None, 0.5,
+                                         jax.random.PRNGKey(s))
+                seen.add(int(tok[0]))
+        assert len(seen) == 2
+
+    def test_moe_decode_capacity_never_drops(self, cpus):
+        """Decode routes with capacity = all units of the step, so a
+        capacity_factor that would drop at per-step (B-unit) granularity
+        still yields the dense no-drop oracle's output."""
+        from petastorm_tpu.models import transformer_lm as tlm
+        cfg = _tiny_config(n_experts=4, moe_top_k=1, moe_capacity_factor=0.25)
+        with jax.default_device(cpus[0]):
+            params = tlm.init(jax.random.PRNGKey(1), cfg)
+            layer = params['layers'][0]
+            # force every token onto expert 0 to maximize contention
+            layer['gate'] = jnp.zeros_like(layer['gate']).at[:, 0].set(10.0)
+            x = jax.random.normal(jax.random.PRNGKey(2), (4, 1, 32),
+                                  jnp.float32)
+            oracle = tlm._moe_ffn_dense(x, layer, cfg)
+            no_drop, _ = tlm._moe_ffn(x, layer, cfg,
+                                      capacity=4 * cfg.moe_top_k)
+            dropped, _ = tlm._moe_ffn(x, layer, cfg)   # default: capacity 1
+        np.testing.assert_allclose(np.asarray(no_drop), np.asarray(oracle),
+                                   rtol=1e-5, atol=1e-5)
+        # documents why the override matters: default capacity drops 3/4 units
+        assert not np.allclose(np.asarray(dropped), np.asarray(oracle),
+                               rtol=1e-5, atol=1e-5)
+
     def test_bad_sampling_params_rejected(self, cpus):
         from petastorm_tpu.models import transformer_lm as tlm
         cfg = _tiny_config()
