@@ -306,6 +306,90 @@ def run_transformer_train_bench(dataset_url: str, batch_size: int = 64,
             count_fn=lambda b: int(b['tokens'].shape[0]))
 
 
+def generate_timeseries_token_dataset(output_url: str, rows: int = 4096,
+                                      chunk: int = 64, vocab: int = 8192,
+                                      seed: int = 0,
+                                      row_group_size_mb: float = 0.5) -> str:
+    """Timestamped token chunks — the raw material for the NGram LM pipeline
+    (SURVEY §5.7: NGram is *the* reference input pipeline for sequence
+    models). Each row is one timestep: ``ts`` orders rows, ``tokens`` holds a
+    fixed-size chunk; the NGram reader assembles consecutive rows into
+    windows at read time."""
+    rng = np.random.default_rng(seed)
+    schema = Unischema('TimeseriesTokens', [
+        UnischemaField('ts', np.int64, (), ScalarCodec(), False),
+        UnischemaField('tokens', np.int32, (chunk,), ArrowListCodec(), False),
+    ])
+
+    def gen():
+        for i in range(rows):
+            yield {'ts': np.int64(i),
+                   'tokens': rng.integers(0, vocab, size=(chunk,),
+                                          dtype=np.int32)}
+
+    with materialize_dataset(output_url, schema,
+                             row_group_size_mb=row_group_size_mb) as writer:
+        writer.write_rows(gen())
+    return output_url
+
+
+def run_ngram_transformer_train_bench(dataset_url: str, window: int = 4,
+                                      chunk: int = 64, batch_size: int = 64,
+                                      num_steps: int = 40,
+                                      warmup_steps: int = 3,
+                                      workers_count: int = None,
+                                      prefetch: int = 4,
+                                      d_model: int = 256, n_layers: int = 4,
+                                      n_heads: int = 8, d_ff: int = 1024,
+                                      vocab: int = 8192) -> InfeedReport:
+    """The full NGram → JAX → LM loop: parquet rows → NGram window assembly
+    (``make_reader(schema_fields=NGram(...))``) → per-timestep collated
+    device batches (``JaxDataLoader``) → flagship LM train step. The window's
+    timestep chunks concatenate on device into one (B, window·chunk)
+    sequence; inputs/targets shift by one token."""
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_utils import JaxDataLoader, prefetch_to_device
+    from petastorm_tpu.models import transformer_lm as tlm
+    from petastorm_tpu.ngram import NGram
+
+    seq_len = window * chunk - 1
+    config = tlm.TransformerConfig(vocab_size=vocab, d_model=d_model,
+                                   n_heads=n_heads, n_layers=n_layers,
+                                   d_ff=d_ff, max_seq_len=seq_len + 1)
+    params = tlm.init(jax.random.PRNGKey(0), config)
+    optimizer, step = tlm.make_train_step(config)
+    opt_state = optimizer.init(params)
+    state = {'params': params, 'opt': opt_state}
+    fields = {0: ['ts', 'tokens']}
+    fields.update({i: ['tokens'] for i in range(1, window)})
+    ngram = NGram(fields, delta_threshold=1, timestamp_field='ts')
+
+    @jax.jit
+    def concat_and_step(params, opt_state, chunks):
+        seq = jnp.concatenate(chunks, axis=1)        # (B, window*chunk)
+        return step(params, opt_state, seq[:, :-1], seq[:, 1:])
+
+    def step_fn(batch):
+        chunks = [batch[i]['tokens'] for i in range(window)]
+        state['params'], state['opt'], loss = concat_and_step(
+            state['params'], state['opt'], chunks)
+        return loss
+
+    with make_reader(dataset_url, schema_fields=ngram,
+                     reader_pool_type='thread',
+                     workers_count=workers_count or _default_workers(),
+                     results_queue_size=_TRAIN_BENCH_QUEUE_CHUNKS,
+                     num_epochs=None) as reader:
+        loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
+        batches = prefetch_to_device(iter(loader), size=prefetch)
+        return measure_infeed_overlap(
+            batches, step_fn, num_steps=num_steps, warmup_steps=warmup_steps,
+            count_fn=lambda b: int(b[0]['tokens'].shape[0]))
+
+
 def run_columnar_read_bench(dataset_url: str, workers_count: int = None) -> dict:
     """Vectorized columnar decode throughput (rows/sec) over a codec dataset —
     the zero-per-row-Python read path the JAX adapter feeds from."""

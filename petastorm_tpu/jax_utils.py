@@ -234,7 +234,10 @@ class JaxDataLoader(JaxLoaderBase):
     (``make_batch_reader``); batched input is fed column-wise into vectorized
     buffers, never exploded into python rows (the perf trap the reference's
     plain ``DataLoader`` falls into and ``BatchedDataLoader`` fixes,
-    ``pytorch.py:204-216`` vs ``:352-408``).
+    ``pytorch.py:204-216`` vs ``:352-408``). NGram readers batch through
+    per-timestep collation: a batch is ``{offset: {field: (B, ...) array}}``
+    and windows shuffle as whole units (reference ngram batching lives only
+    in the TF adapter, ``tf_utils.py:141-183``).
 
     :param shuffling_queue_capacity: 0 disables shuffling; otherwise a
         uniform-shuffling buffer of that many rows decorrelates row-group order.
@@ -248,13 +251,15 @@ class JaxDataLoader(JaxLoaderBase):
                  transform_fn=None, drop_last=False, seed=None,
                  inmemory_cache_all=False, pad_spec=None):
         super(JaxDataLoader, self).__init__(reader)
-        if getattr(reader, 'ngram', None) is not None:
-            # NGram rows are {offset: namedtuple} dicts; batching them needs
-            # per-timestep collation this loader does not implement (the
-            # reference torch loader refuses them too, pytorch.py:150-152).
-            raise NotImplementedError(
-                'JaxDataLoader does not support NGram readers; iterate the '
-                'reader directly or use a TransformSpec to flatten windows')
+        # NGram rows are {offset: namedtuple} windows; they batch through
+        # per-timestep collation into {offset: dict-of-column-arrays} —
+        # mirroring the TF adapter's ngram path (reference
+        # ``tf_utils.py:141-183``; the reference torch loader refuses ngram,
+        # ``pytorch.py:150-152``).
+        self._ngram = getattr(reader, 'ngram', None)
+        if self._ngram is not None and pad_spec:
+            raise ValueError('pad_spec is not supported with NGram readers '
+                             '(window fields are fixed-shape per timestep)')
         self.batch_size = batch_size
         self.shuffling_queue_capacity = shuffling_queue_capacity
         self.transform_fn = transform_fn
@@ -297,6 +302,8 @@ class JaxDataLoader(JaxLoaderBase):
             self._cache = []
         if self.reader.batched_output:
             gen = self._iter_batched()
+        elif self._ngram is not None:
+            gen = self._iter_ngram()
         else:
             gen = self._iter_rows()
         for batch in gen:
@@ -328,6 +335,26 @@ class JaxDataLoader(JaxLoaderBase):
                 yield batch
 
     def _iter_rows(self):
+        def prepare(row):
+            return sanitize_jax_types(row._asdict()
+                                      if hasattr(row, '_asdict') else dict(row))
+        return self._iter_row_stream(prepare, self._collate)
+
+    def _iter_ngram(self):
+        """NGram windows ({offset: namedtuple}) → per-timestep collated
+        batches: ``{offset: {field: (B, ...) array}}`` — windows shuffle as
+        whole units so timestep alignment survives the buffer."""
+        def collate(windows):
+            out = {}
+            for offset in sorted(windows[0].keys()):
+                rows = [sanitize_jax_types(dict(w[offset]._asdict()))
+                        for w in windows]
+                out[offset] = self._collate(rows)
+            return out
+        return self._iter_row_stream(lambda w: w, collate)
+
+    def _iter_row_stream(self, prepare, collate):
+        """Shared row-granular loop: shuffle buffer → fixed-size batches."""
         if self.shuffling_queue_capacity > 0:
             min_after = max(1, self.shuffling_queue_capacity - 1)
             buffer = RandomShufflingBuffer(
@@ -342,14 +369,13 @@ class JaxDataLoader(JaxLoaderBase):
             while buffer.can_retrieve():
                 rows.append(buffer.retrieve())
                 if len(rows) == self.batch_size:
-                    yield self._collate(rows)
+                    yield collate(rows)
                     rows.clear()
             if final and rows and not self.drop_last:
-                yield self._collate(rows)
+                yield collate(rows)
 
         for row in self.reader:
-            row = sanitize_jax_types(row._asdict()
-                                     if hasattr(row, '_asdict') else dict(row))
+            row = prepare(row)
             while not buffer.can_add():
                 for b in drain(False):
                     yield b
@@ -389,9 +415,12 @@ class ShardedJaxLoader(JaxLoaderBase):
     Under multi-host TPU each process constructs only its local shard
     (``local_batch_size = global_batch_size // process_count``) and XLA sees one
     logical array — the idiomatic replacement for the reference's static
-    rank/size shard arithmetic. ``drop_last`` is forced True so every host
-    yields the same number of steps and collective programs never deadlock on
-    ragged epochs (SURVEY §7 "hard parts").
+    rank/size shard arithmetic. ``drop_last`` is forced True (no ragged
+    batches), and under ``process_count > 1`` every step is preceded by a
+    cross-host readiness allgather so all hosts yield exactly the same number
+    of steps even when row-group sharding is unbalanced — a host with a
+    surplus batch drops it instead of deadlocking the others' collectives
+    (SURVEY §7 "hard parts").
 
     String/object columns cannot live in HBM; they are returned under
     ``batch['_host']`` untouched.
@@ -402,6 +431,15 @@ class ShardedJaxLoader(JaxLoaderBase):
                  inmemory_cache_all=False, pad_spec=None):
         super(ShardedJaxLoader, self).__init__(reader)
         from jax.sharding import NamedSharding, PartitionSpec
+        if getattr(reader, 'ngram', None) is not None:
+            # NGram batches are nested {offset: {field: array}} dicts;
+            # stage_to_global stages flat columns — without this guard the
+            # nested dicts would silently land under batch['_host'] with no
+            # global arrays at all
+            raise NotImplementedError(
+                'ShardedJaxLoader does not support NGram readers; use '
+                'JaxDataLoader + prefetch_to_device and shard the '
+                'concatenated windows explicitly')
         self.mesh = mesh
         self.batch_axis = batch_axis
         normalized_pad = validate_pad_spec(pad_spec)
@@ -429,8 +467,40 @@ class ShardedJaxLoader(JaxLoaderBase):
         return self._loader._cache_hot()
 
     def _iter_impl(self):
-        for batch in self._loader._iter_impl():
+        import jax
+        lockstep = jax.process_count() > 1
+        it = self._loader._iter_impl()
+        while True:
+            batch = next(it, None)
+            if lockstep:
+                # Cross-host agreement before every step: row-group sharding
+                # can hand one host a batch more than another (9 row groups
+                # over 2 hosts), and a host entering a collective the others
+                # never reach deadlocks the cluster. All hosts stop together
+                # at the shortest host's stream; a surplus local batch is
+                # dropped (the multi-host extension of drop_last).
+                if not _all_processes_ready(batch is not None):
+                    # Drain the surplus before stopping: abandoning the inner
+                    # generator mid-stream would leave the epoch cache
+                    # incomplete and the Reader unfinished (reset() would
+                    # refuse), breaking the NEXT pass on this host only.
+                    for _ in it:
+                        pass
+                    return
+            elif batch is None:
+                return
             yield stage_to_global(batch, self._named_sharding)
+
+
+def _all_processes_ready(local_ready: bool) -> bool:
+    """True iff EVERY process has a next batch. One tiny allgather per step —
+    the price of streaming readers not knowing their row count up front."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(
+        np.asarray([1 if local_ready else 0], np.int32))
+    return bool(np.asarray(flags).min())
 
 
 def stage_to_global(batch, named_sharding):
