@@ -1,0 +1,80 @@
+"""Child process for the real multi-process STREAMING reader test.
+
+Launched by ``tests/test_multihost_process.py`` with::
+
+    python multihost_stream_child.py <coordinator> <num_processes> \
+        <process_id> <dataset_url> <local_batch_size> <num_epochs>
+
+Each process joins a real ``jax.distributed`` cluster (CPU backend, 2 local
+virtual devices), builds ``make_reader(shard_by_jax_process=True)`` →
+``ShardedJaxLoader`` over the global mesh, and prints per step::
+
+    STEP <sha256-of-global-id-column> LOCAL <comma-separated local-shard ids>
+
+Global digests must agree across processes (same assembled global array);
+LOCAL ids must be disjoint across processes (row-group sharding); and the
+number of STEP lines must be identical on every process even when the shard
+row counts differ (the lockstep-stop protocol under test).
+"""
+
+import hashlib
+import os
+import sys
+
+import numpy as np
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+_kept = [f for f in os.environ.get('XLA_FLAGS', '').split()
+         if not f.startswith('--xla_force_host_platform_device_count')]
+os.environ['XLA_FLAGS'] = ' '.join(
+    _kept + ['--xla_force_host_platform_device_count=2'])
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..'))
+
+
+def main():
+    (coordinator, num_processes, process_id, dataset_url, local_batch,
+     num_epochs) = sys.argv[1:7]
+    import jax
+    jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=int(num_processes),
+                               process_id=int(process_id))
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_utils import ShardedJaxLoader
+    from petastorm_tpu.parallel import make_mesh
+
+    assert jax.process_count() == int(num_processes)
+    mesh = make_mesh({'data': len(jax.devices())})
+    replicate = jax.jit(lambda x: x,
+                        out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+    with make_reader(dataset_url, shard_by_jax_process=True,
+                     shuffle_row_groups=False, num_epochs=int(num_epochs),
+                     reader_pool_type='thread', workers_count=2) as reader:
+        loader = ShardedJaxLoader(reader, mesh,
+                                  local_batch_size=int(local_batch))
+        steps = 0
+        # two passes: the second exercises drain-then-reset on the host whose
+        # surplus batch was dropped by the lockstep-stop protocol
+        for pass_idx in range(2):
+            for batch in loader:
+                arr = batch['id']
+                local = np.sort(np.concatenate(
+                    [np.asarray(s.data).ravel()
+                     for s in arr.addressable_shards]))
+                full = replicate(arr)
+                ids = np.ascontiguousarray(
+                    np.asarray(full.addressable_data(0)), dtype=np.int64)
+                digest = hashlib.sha256(ids.tobytes()).hexdigest()[:24]
+                print('STEP {} {} LOCAL {}'.format(
+                    pass_idx, digest,
+                    ','.join(str(int(i)) for i in local)), flush=True)
+                steps += 1
+    print('DONE {}'.format(steps), flush=True)
+
+
+if __name__ == '__main__':
+    main()
