@@ -1,14 +1,35 @@
 #!/usr/bin/env bash
 # Reproducible test runner (works in the docker image or any checkout with
-# the deps installed). Mirrors what the round driver runs, plus the type
-# check when mypy is available.
+# the deps installed).
+#
+# Lanes:
+#   ci/run_tests.sh         # fast lane (default): skips @pytest.mark.slow —
+#                           # interpret-mode Pallas kernels, LM training,
+#                           # real multi-process clusters
+#   ci/run_tests.sh full    # everything (what the round driver runs)
+#
+# Both lanes run the multi-chip dry run and (when available) mypy.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo '== pytest =='
-python -m pytest tests/ -x -q
+LANE="${1:-fast}"
+
+case "$LANE" in
+  fast)
+    echo '== pytest (fast lane: -m "not slow") =='
+    python -m pytest tests/ -x -q -m 'not slow'
+    ;;
+  full)
+    echo '== pytest (full suite) =='
+    python -m pytest tests/ -x -q
+    ;;
+  *)
+    echo "usage: $0 [fast|full]" >&2
+    exit 2
+    ;;
+esac
 
 echo '== multi-chip dry run (8 virtual devices) =='
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -21,4 +42,4 @@ else
     echo '== mypy not installed; skipping type check =='
 fi
 
-echo 'ALL CI CHECKS PASSED'
+echo "ALL CI CHECKS PASSED (lane: $LANE)"

@@ -13,6 +13,7 @@ import numpy as np
 
 from petastorm_tpu import make_reader, materialize_dataset
 from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.jax_utils import JaxDataLoader, prefetch_to_device
 from petastorm_tpu.ngram import NGram
 from petastorm_tpu.unischema import Unischema, UnischemaField
 
@@ -59,15 +60,15 @@ def train(dataset_url, steps=20, mesh=None):
     losses = []
     with make_reader(dataset_url, schema_fields=ngram, num_epochs=None,
                      shuffle_row_groups=False) as reader:
-        window_batch = []
-        for window in reader:
-            window_batch.append(window)
-            if len(window_batch) < 8:
-                continue
-            tokens = jnp.stack([jnp.asarray(w[0].tokens) for w in window_batch])
+        # NGram windows batch through the JAX loader with per-timestep
+        # collation: a batch is {offset: {field: (B, ...) array}}, staged to
+        # the device by the prefetch pipeline
+        loader = JaxDataLoader(reader, batch_size=8, drop_last=True)
+        for batch in prefetch_to_device(iter(loader), size=2):
+            tokens = batch[0]['tokens']
             # next-token targets: shift within the window, next chunk's first
             # token closes the gap — exact continuation thanks to NGram
-            nxt = jnp.stack([jnp.asarray(w[1].tokens[0]) for w in window_batch])
+            nxt = batch[1]['tokens'][:, 0]
             targets = jnp.concatenate([tokens[:, 1:], nxt[:, None]], axis=1)
             if mesh is not None:
                 bshard = NamedSharding(mesh, tlm.batch_spec(mesh))
@@ -75,7 +76,6 @@ def train(dataset_url, steps=20, mesh=None):
                 targets = jax.device_put(targets, bshard)
             params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
             losses.append(float(loss))
-            window_batch = []
             if len(losses) >= steps:
                 break
     print('first loss {:.3f} -> last loss {:.3f}'.format(losses[0], losses[-1]))

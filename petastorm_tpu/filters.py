@@ -67,12 +67,14 @@ def normalize_filters(filters) -> Optional[List[Conjunction]]:
                 raise ValueError('Unsupported filter op {!r} on column {!r}; '
                                  'supported: {}'.format(op, col,
                                                         sorted(FILTER_OPS)))
-            if op in ('in', 'not in') and not isinstance(
-                    val, (list, tuple, set, frozenset)):
-                # a bare string would pass the iterable check and then
-                # evaluate with substring semantics at row time
+            if op in ('in', 'not in') and (
+                    isinstance(val, (str, bytes))
+                    or not hasattr(val, '__iter__')):
+                # a bare string is iterable but would evaluate with substring
+                # semantics at row time; any real collection (list, set,
+                # numpy array, range, ...) is fine
                 raise ValueError(
-                    "filter ({!r}, {!r}, ...) needs a list/tuple/set value; "
+                    "filter ({!r}, {!r}, ...) needs a collection value; "
                     'got {!r}'.format(col, op, val))
     return conjunctions
 
@@ -135,7 +137,7 @@ def _eval_term(actual, op: str, val) -> bool:
     # type so ('id', '>', 5) works on an unregistered partition column. For
     # in/not-in the element type drives the coercion.
     if isinstance(actual, str):
-        if isinstance(val, (list, tuple, set, frozenset)):
+        if op in ('in', 'not in'):
             ref = next(iter(val), None)
             if ref is not None and not isinstance(ref, str):
                 actual = cast_string_to_type(type(ref), actual)

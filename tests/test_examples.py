@@ -5,6 +5,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow    # kernels / model training: minutes-scale (fast lane skips)
+
 
 @pytest.fixture(autouse=True)
 def examples_on_path(monkeypatch):

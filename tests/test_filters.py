@@ -53,10 +53,13 @@ def test_normalize_rejects_empty_conjunction():
 def test_normalize_rejects_bare_string_for_in():
     """A bare string passes iterable checks but evaluates with substring
     semantics — reject it up front like pyarrow does."""
-    with pytest.raises(ValueError, match='list/tuple/set'):
+    with pytest.raises(ValueError, match='collection'):
         normalize_filters([('name', 'in', 'row_3')])
-    with pytest.raises(ValueError, match='list/tuple/set'):
+    with pytest.raises(ValueError, match='collection'):
         normalize_filters([('name', 'not in', 'row_3')])
+    # real collections beyond list/tuple/set are fine
+    assert normalize_filters([('id', 'in', np.array([1, 2]))])
+    assert normalize_filters([('id', 'in', range(3))])
 
 
 @pytest.mark.parametrize('op,val,mn,mx,expected', [

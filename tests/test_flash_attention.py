@@ -15,6 +15,8 @@ from conftest import ref_attention as _exact
 
 from petastorm_tpu.ops.attention import blockwise_attention, flash_attention
 
+pytestmark = pytest.mark.slow    # kernels / model training: minutes-scale (fast lane skips)
+
 _RNG = np.random.default_rng(0)
 
 

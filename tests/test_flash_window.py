@@ -12,6 +12,8 @@ jax.config.update('jax_default_matmul_precision', 'highest')
 
 from petastorm_tpu.ops.attention import blockwise_attention, flash_attention
 
+pytestmark = pytest.mark.slow    # kernels / model training: minutes-scale (fast lane skips)
+
 
 @pytest.fixture()
 def cpu():

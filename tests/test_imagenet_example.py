@@ -8,6 +8,8 @@ import examples.imagenet.generate_imagenet as gen
 from examples.imagenet.main import make_resize_transform, train
 from petastorm_tpu import make_columnar_reader, make_reader
 
+pytestmark = pytest.mark.slow    # kernels / model training: minutes-scale (fast lane skips)
+
 
 @pytest.fixture(scope='module')
 def imagenet_dataset(tmp_path_factory):

@@ -24,6 +24,8 @@ def cpus():
 
 from conftest import ref_attention as _ref_attention  # noqa: E402
 
+pytestmark = pytest.mark.slow    # kernels / model training: minutes-scale (fast lane skips)
+
 
 @pytest.fixture(scope='module')
 def qkv(cpus):

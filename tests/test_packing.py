@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from petastorm_tpu.packing import pack_documents
 
 
+
 class TestPackDocuments:
     def test_basic_two_rows(self):
         docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10]]
@@ -77,6 +78,7 @@ class TestPackDocuments:
             pack_documents([[1] * 4, [2] * 4, [3] * 4], seq_len=4, num_rows=2)
 
 
+@pytest.mark.slow    # LM forward equivalence: minutes-scale
 class TestPackedModelForward:
     def test_packed_equals_per_document(self):
         """Logits of packed documents must equal each document's logits run
