@@ -269,16 +269,23 @@ def run_imagenet_train_bench(dataset_url: str, batch_size: int = 32,
 
 def run_transformer_train_bench(dataset_url: str, batch_size: int = 64,
                                 num_steps: int = 40, warmup_steps: int = 3,
-                                workers_count: int = None, prefetch: int = 4,
+                                workers_count: int = None, prefetch: int = 8,
                                 d_model: int = 256, n_layers: int = 4,
                                 n_heads: int = 8, d_ff: int = 1024,
-                                seq_len: int = 256,
-                                vocab: int = 8192) -> InfeedReport:
-    """Train the flagship LM from parquet token windows."""
+                                seq_len: int = 256, vocab: int = 8192,
+                                dispatch_ahead: int = 2) -> InfeedReport:
+    """Train the flagship LM from parquet token windows.
+
+    The LM step is ~1ms on a v5e chip, so the infeed is latency-bound:
+    batches prefetch as raw numpy (``prefetch_batches``) and the jitted
+    step's own dispatch performs the transfer — one dispatch per step
+    instead of device_put + execute, measured r04 at ~99% overlap vs 86-90%
+    with explicit staging. ``dispatch_ahead=2`` measures the loop users
+    actually run (async XLA dispatch; see ``measure_infeed_overlap``)."""
     import jax
 
     from petastorm_tpu import make_columnar_reader
-    from petastorm_tpu.jax_utils import JaxDataLoader, prefetch_to_device
+    from petastorm_tpu.jax_utils import JaxDataLoader, prefetch_batches
     from petastorm_tpu.models import transformer_lm as tlm
 
     config = tlm.TransformerConfig(vocab_size=vocab, d_model=d_model,
@@ -300,10 +307,11 @@ def run_transformer_train_bench(dataset_url: str, batch_size: int = 64,
                               results_queue_size=_TRAIN_BENCH_QUEUE_CHUNKS,
                               num_epochs=None) as reader:
         loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
-        batches = prefetch_to_device(iter(loader), size=prefetch)
+        batches = prefetch_batches(iter(loader), size=prefetch)
         return measure_infeed_overlap(
             batches, step_fn, num_steps=num_steps, warmup_steps=warmup_steps,
-            count_fn=lambda b: int(b['tokens'].shape[0]))
+            count_fn=lambda b: int(b['tokens'].shape[0]),
+            dispatch_ahead=dispatch_ahead)
 
 
 def generate_timeseries_token_dataset(output_url: str, rows: int = 4096,
@@ -338,10 +346,11 @@ def run_ngram_transformer_train_bench(dataset_url: str, window: int = 4,
                                       num_steps: int = 40,
                                       warmup_steps: int = 3,
                                       workers_count: int = None,
-                                      prefetch: int = 4,
+                                      prefetch: int = 8,
                                       d_model: int = 256, n_layers: int = 4,
                                       n_heads: int = 8, d_ff: int = 1024,
-                                      vocab: int = 8192) -> InfeedReport:
+                                      vocab: int = 8192,
+                                      dispatch_ahead: int = 2) -> InfeedReport:
     """The full NGram → JAX → LM loop: parquet rows → NGram window assembly
     (``make_reader(schema_fields=NGram(...))``) → per-timestep collated
     device batches (``JaxDataLoader``) → flagship LM train step. The window's
@@ -351,7 +360,7 @@ def run_ngram_transformer_train_bench(dataset_url: str, window: int = 4,
     import jax.numpy as jnp
 
     from petastorm_tpu import make_reader
-    from petastorm_tpu.jax_utils import JaxDataLoader, prefetch_to_device
+    from petastorm_tpu.jax_utils import JaxDataLoader, prefetch_batches
     from petastorm_tpu.models import transformer_lm as tlm
     from petastorm_tpu.ngram import NGram
 
@@ -384,10 +393,11 @@ def run_ngram_transformer_train_bench(dataset_url: str, window: int = 4,
                      results_queue_size=_TRAIN_BENCH_QUEUE_CHUNKS,
                      num_epochs=None) as reader:
         loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
-        batches = prefetch_to_device(iter(loader), size=prefetch)
+        batches = prefetch_batches(iter(loader), size=prefetch)
         return measure_infeed_overlap(
             batches, step_fn, num_steps=num_steps, warmup_steps=warmup_steps,
-            count_fn=lambda b: int(b[0]['tokens'].shape[0]))
+            count_fn=lambda b: int(b[0]['tokens'].shape[0]),
+            dispatch_ahead=dispatch_ahead)
 
 
 def run_columnar_read_bench(dataset_url: str, workers_count: int = None) -> dict:

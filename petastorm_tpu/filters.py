@@ -51,13 +51,15 @@ def normalize_filters(filters) -> Optional[List[Conjunction]]:
     if not filters:
         return None
     if isinstance(filters[0], tuple):
-        conjunctions = [list(filters)]
+        raw_conjunctions = [list(filters)]
     else:
-        conjunctions = [list(c) for c in filters]
-    for conjunction in conjunctions:
-        if not conjunction:
+        raw_conjunctions = [list(c) for c in filters]
+    conjunctions = []
+    for raw in raw_conjunctions:
+        if not raw:
             raise ValueError('filters contains an empty conjunction')
-        for term in conjunction:
+        conjunction: Conjunction = []
+        for term in raw:
             if not (isinstance(term, tuple) and len(term) == 3):
                 raise ValueError(
                     'filter terms must be (column, op, value) tuples; got '
@@ -67,15 +69,22 @@ def normalize_filters(filters) -> Optional[List[Conjunction]]:
                 raise ValueError('Unsupported filter op {!r} on column {!r}; '
                                  'supported: {}'.format(op, col,
                                                         sorted(FILTER_OPS)))
-            if op in ('in', 'not in') and (
-                    isinstance(val, (str, bytes))
-                    or not hasattr(val, '__iter__')):
-                # a bare string is iterable but would evaluate with substring
-                # semantics at row time; any real collection (list, set,
-                # numpy array, range, ...) is fine
-                raise ValueError(
-                    "filter ({!r}, {!r}, ...) needs a collection value; "
-                    'got {!r}'.format(col, op, val))
+            if op in ('in', 'not in'):
+                if isinstance(val, (str, bytes)) \
+                        or not hasattr(val, '__iter__'):
+                    # a bare string is iterable but would evaluate with
+                    # substring semantics at row time; any real collection
+                    # (list, set, numpy array, range, ...) is fine
+                    raise ValueError(
+                        "filter ({!r}, {!r}, ...) needs a collection value; "
+                        'got {!r}'.format(col, op, val))
+                # materialize: the value is evaluated many times (per row in
+                # workers, per row group at planning) — a one-shot iterator
+                # would silently exhaust after the first evaluation, and a
+                # list also pickles cleanly for process pools
+                val = list(val)
+            conjunction.append((col, op, val))
+        conjunctions.append(conjunction)
     return conjunctions
 
 

