@@ -17,10 +17,13 @@ nondeterministic pool the resume is best-effort: epoch boundaries are exact,
 the intra-epoch position is approximate.
 
 For **O(1) exact resume with any worker count** use
-:mod:`petastorm_tpu.indexed` (``make_indexed_loader``): batches are addressed
-by (seed, epoch, index), so its cursor restores instantly and byte-exactly —
+:mod:`petastorm_tpu.indexed` (``make_indexed_loader``; batches addressed by
+(seed, epoch, index)) or, for NGram window pipelines,
+:mod:`petastorm_tpu.indexed_ngram` (``make_indexed_ngram_loader``; windows
+addressed the same way). Their cursors restore instantly and byte-exactly —
 no replay. This module remains the replay fallback for the queue-based
-streaming readers (NGram, predicates, ragged fields).
+streaming readers (ragged fields, weighted mixes, worker-side predicates
+over streaming pools).
 """
 
 from __future__ import annotations
