@@ -80,6 +80,12 @@ def main():
             lambda: generate_hello_world_dataset(url, rows_count=hello_rows,
                                                  row_group_size_mb=32))
 
+    # one discarded priming run: the 10k-row store is ~1.4GB and the first
+    # pass after generation streams from cold page cache — disk speed, not
+    # reader speed
+    reader_throughput(url, warmup_cycles=100, measure_cycles=10000,
+                      pool_type='thread', workers_count=3,
+                      read_method='python')
     runs = []
     for _ in range(5):
         result = reader_throughput(url, warmup_cycles=1000,
@@ -208,9 +214,14 @@ def main():
     # artifact itself so BENCH JSON is self-consistent without the docs.
     def _consistency(decode, train):
         d, t = decode['samples_per_sec'], train.samples_per_sec
+        margin = 100.0 * (d - t) / d if d else None
         return {'decode_only': round(d, 2), 'train': round(t, 2),
                 'decode_ge_train': d >= t,
-                'margin_pct': round(100.0 * (d - t) / d, 2) if d else None}
+                # a decode-bound train line measures the same decode ceiling
+                # as the decode-only line: equality within measurement noise
+                # satisfies the invariant
+                'consistent_within_1pct': d >= t or (d > 0 and (t - d) / d < 0.01),
+                'margin_pct': round(margin, 2) if margin is not None else None}
 
     consistency = {
         'png': _consistency(img_decode, imagenet),

@@ -80,9 +80,14 @@ def normalize_filters(filters) -> Optional[List[Conjunction]]:
                         'got {!r}'.format(col, op, val))
                 # materialize: the value is evaluated many times (per row in
                 # workers, per row group at planning) — a one-shot iterator
-                # would silently exhaust after the first evaluation, and a
-                # list also pickles cleanly for process pools
-                val = list(val)
+                # would silently exhaust after the first evaluation. Prefer a
+                # frozenset (O(1) membership per row; pickles cleanly);
+                # unhashable elements fall back to a list.
+                materialized = list(val)
+                try:
+                    val = frozenset(materialized)
+                except TypeError:
+                    val = materialized
             conjunction.append((col, op, val))
         conjunctions.append(conjunction)
     return conjunctions

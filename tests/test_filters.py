@@ -32,7 +32,9 @@ def test_normalize_single_conjunction():
 
 def test_normalize_dnf():
     dnf = [[('a', '>', 1)], [('b', '=', 2), ('c', 'in', [1, 2])]]
-    assert normalize_filters(dnf) == dnf
+    # in/not-in values materialize to frozensets (O(1) row membership)
+    assert normalize_filters(dnf) == [
+        [('a', '>', 1)], [('b', '=', 2), ('c', 'in', frozenset({1, 2}))]]
 
 
 def test_normalize_rejects_bad_op():
@@ -57,15 +59,16 @@ def test_normalize_rejects_bare_string_for_in():
         normalize_filters([('name', 'in', 'row_3')])
     with pytest.raises(ValueError, match='collection'):
         normalize_filters([('name', 'not in', 'row_3')])
-    # real collections beyond list/tuple/set are fine — and are materialized
-    # to lists so repeated evaluation and process-pool pickling both work
+    # real collections beyond list/tuple/set are fine — and materialize to
+    # frozensets (O(1) membership per row; repeated evaluation and
+    # process-pool pickling both work)
     assert normalize_filters([('id', 'in', np.array([1, 2]))]) == \
-        [[('id', 'in', [1, 2])]]
+        [[('id', 'in', frozenset({1, 2}))]]
     assert normalize_filters([('id', 'in', range(3))]) == \
-        [[('id', 'in', [0, 1, 2])]]
+        [[('id', 'in', frozenset({0, 1, 2}))]]
     # a one-shot generator is materialized once, not silently exhausted
     norm = normalize_filters([('id', 'in', (x for x in [5, 6]))])
-    assert norm == [[('id', 'in', [5, 6])]]
+    assert norm == [[('id', 'in', frozenset({5, 6}))]]
 
 
 @pytest.mark.parametrize('op,val,mn,mx,expected', [
