@@ -11,7 +11,7 @@ from petastorm_tpu.errors import NoDataAvailableError  # noqa: F401
 from petastorm_tpu.transform import TransformSpec  # noqa: F401
 
 __all__ = ['make_reader', 'make_batch_reader', 'make_columnar_reader',
-           'make_indexed_loader',
+           'make_indexed_loader', 'make_indexed_ngram_loader',
            'TransformSpec', 'NoDataAvailableError',
            'make_jax_loader', 'make_dataset_converter', 'materialize_dataset',
            '__version__']
@@ -25,6 +25,9 @@ def __getattr__(name):
     if name == 'make_indexed_loader':
         from petastorm_tpu.indexed import make_indexed_loader
         return make_indexed_loader
+    if name == 'make_indexed_ngram_loader':
+        from petastorm_tpu.indexed_ngram import make_indexed_ngram_loader
+        return make_indexed_ngram_loader
     if name == 'make_jax_loader':
         from petastorm_tpu.jax_utils import make_jax_loader
         return make_jax_loader

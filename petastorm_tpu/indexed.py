@@ -534,6 +534,35 @@ class IndexedBatchLoader:
                 self._dataset.close()
 
 
+def sharded_batch_setup(mesh, batch_axis: str, batch_size: int):
+    """Validate a global batch against a mesh axis and derive this process's
+    ``(NamedSharding, local_positions)``.
+
+    Positions come from the sharding's own device→index map — NOT from
+    process_index block arithmetic: topology-permuted meshes
+    (``mesh_utils.create_device_mesh``) can place a process's devices at
+    non-contiguous global offsets, and
+    ``make_array_from_process_local_data`` lays local data out by that map.
+    Shared by the sharded row and NGram loaders."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    nproc = jax.process_count()
+    if batch_size % nproc:
+        raise ValueError('global batch_size {} must divide evenly over {} '
+                         'processes'.format(batch_size, nproc))
+    n_shards = int(mesh.shape[batch_axis])
+    if batch_size % n_shards:
+        raise ValueError(
+            'global batch_size {} must divide evenly over the {} devices '
+            "of mesh axis '{}'".format(batch_size, n_shards, batch_axis))
+    sharding = NamedSharding(mesh, PartitionSpec(batch_axis))
+    idx_map = sharding.addressable_devices_indices_map((batch_size,))
+    positions = set()
+    for (sl,) in idx_map.values():
+        positions.update(range(*sl.indices(batch_size)))
+    return sharding, np.asarray(sorted(positions), np.int64)
+
+
 class ShardedIndexedLoader(IndexedBatchLoader):
     """Deterministic GSPMD loader: O(1) exact resume + global ``jax.Array``
     batches over a mesh.
@@ -556,31 +585,13 @@ class ShardedIndexedLoader(IndexedBatchLoader):
 
     def __init__(self, dataset: IndexedDatasetReader, batch_size: int,
                  mesh, batch_axis: str = 'data', **kwargs):
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec
-        nproc = jax.process_count()
-        if batch_size % nproc:
-            raise ValueError('global batch_size {} must divide evenly over {} '
-                             'processes'.format(batch_size, nproc))
-        n_shards = int(mesh.shape[batch_axis])
-        if batch_size % n_shards:
-            raise ValueError(
-                'global batch_size {} must divide evenly over the {} devices '
-                "of mesh axis '{}'".format(batch_size, n_shards, batch_axis))
+        sharding, local_positions = sharded_batch_setup(mesh, batch_axis,
+                                                        batch_size)
         super().__init__(dataset, batch_size, **kwargs)
         self.mesh = mesh
         self.batch_axis = batch_axis
-        self._sharding = NamedSharding(mesh, PartitionSpec(batch_axis))
-        # Global batch positions owned by THIS process's devices, derived from
-        # the sharding's own device→index map — NOT from process_index block
-        # arithmetic: topology-permuted meshes (mesh_utils.create_device_mesh)
-        # can place a process's devices at non-contiguous global offsets, and
-        # make_array_from_process_local_data lays local data out by that map.
-        idx_map = self._sharding.addressable_devices_indices_map((batch_size,))
-        positions = set()
-        for (sl,) in idx_map.values():
-            positions.update(range(*sl.indices(batch_size)))
-        self._local_positions = np.asarray(sorted(positions), np.int64)
+        self._sharding = sharding
+        self._local_positions = local_positions
 
     def _assemble(self, epoch: int, batch: int) -> Dict[str, np.ndarray]:
         rows = self._batch_rows(epoch, batch)

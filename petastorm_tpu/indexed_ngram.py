@@ -202,6 +202,41 @@ class IndexedNGramLoader(IndexedBatchLoader):
         return out
 
 
+class ShardedIndexedNGramLoader(IndexedNGramLoader):
+    """Deterministic GSPMD NGram batches: O(1) exact resume + global
+    ``jax.Array`` window batches over a mesh.
+
+    ``batch_size`` is the GLOBAL window batch. Every process derives the
+    same (seed, epoch, batch)-addressed window permutation and assembles
+    only the windows at the global positions its mesh devices own; each
+    timestep's columns lift into global arrays via
+    ``jax.make_array_from_process_local_data`` (the nested ``{offset:
+    {field: ...}}`` layout stages per offset). All hosts stay in lockstep by
+    construction — the schedule is a pure function of the cursor, so no
+    per-step readiness collective is needed (unlike the streaming
+    ``ShardedJaxLoader``)."""
+
+    def __init__(self, dataset: IndexedDatasetReader, ngram: NGram,
+                 batch_size: int, mesh, batch_axis: str = 'data', **kwargs):
+        from petastorm_tpu.indexed import sharded_batch_setup
+        sharding, local_positions = sharded_batch_setup(mesh, batch_axis,
+                                                        batch_size)
+        super().__init__(dataset, ngram, batch_size, **kwargs)
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self._sharding = sharding
+        self._local_positions = local_positions
+
+    def _batch_rows(self, epoch: int, batch: int) -> np.ndarray:
+        return super()._batch_rows(epoch, batch)[self._local_positions]
+
+    def __iter__(self):
+        from petastorm_tpu.jax_utils import stage_to_global
+        for batch in super().__iter__():
+            yield {off: stage_to_global(cols, self._sharding)
+                   for off, cols in batch.items()}
+
+
 def make_indexed_ngram_loader(dataset_url, ngram: NGram, batch_size: int,
                               num_epochs: int = 1, seed: int = 0,
                               shuffle: bool = True,
@@ -209,8 +244,11 @@ def make_indexed_ngram_loader(dataset_url, ngram: NGram, batch_size: int,
                               workers_count: int = 4,
                               prefetch_batches: int = 8,
                               storage_options=None,
-                              cache_groups=None) -> IndexedNGramLoader:
-    """Factory: deterministic, O(1)-resumable NGram window batches.
+                              cache_groups=None, mesh=None,
+                              batch_axis: str = 'data') -> IndexedNGramLoader:
+    """Factory: deterministic, O(1)-resumable NGram window batches — host
+    numpy batches, or global ``jax.Array`` batches over ``mesh``
+    (``batch_size`` is then the global window batch).
 
     ::
 
@@ -224,9 +262,11 @@ def make_indexed_ngram_loader(dataset_url, ngram: NGram, batch_size: int,
         dataset_url, storage_options=storage_options,
         cache_groups=(cache_groups if cache_groups is not None
                       else max(8, shuffle_window_groups + workers_count)))
-    return IndexedNGramLoader(dataset, ngram, batch_size,
-                              num_epochs=num_epochs, seed=seed,
-                              shuffle=shuffle,
-                              shuffle_window_groups=shuffle_window_groups,
-                              workers_count=workers_count,
-                              prefetch_batches=prefetch_batches)
+    kwargs = dict(num_epochs=num_epochs, seed=seed, shuffle=shuffle,
+                  shuffle_window_groups=shuffle_window_groups,
+                  workers_count=workers_count,
+                  prefetch_batches=prefetch_batches)
+    if mesh is None:
+        return IndexedNGramLoader(dataset, ngram, batch_size, **kwargs)
+    return ShardedIndexedNGramLoader(dataset, ngram, batch_size, mesh=mesh,
+                                     batch_axis=batch_axis, **kwargs)

@@ -261,6 +261,64 @@ def test_too_few_windows_raises(tmp_path):
         make_indexed_ngram_loader(url, _ngram(3), batch_size=16)
 
 
+class TestShardedIndexedNGram:
+    """Global jax.Array window batches over the virtual 8-device CPU mesh."""
+
+    def _mesh(self):
+        import jax
+        from petastorm_tpu.parallel import make_mesh
+        devices = jax.devices('cpu')
+        if len(devices) < 8:
+            pytest.skip('needs 8 CPU devices')
+        return make_mesh({'data': 8}, devices=devices)
+
+    def test_global_batches_match_host_loader(self, tmp_path):
+        import jax
+        url = _write(tmp_path / 'sharded', list(range(60)))
+        ngram = _ngram(2)
+        mesh = self._mesh()
+        kwargs = dict(batch_size=8, num_epochs=1, seed=4, workers_count=2)
+        host = make_indexed_ngram_loader(url, ngram, **kwargs)
+        sharded = make_indexed_ngram_loader(url, ngram, mesh=mesh, **kwargs)
+        host_batches = list(host)
+        got = 0
+        for hb, sb in zip(host_batches, sharded):
+            for off in (0, 1):
+                for field in hb[off]:
+                    arr = sb[off][field]
+                    assert isinstance(arr, jax.Array)
+                    assert arr.sharding.is_fully_addressable
+                    np.testing.assert_array_equal(np.asarray(arr),
+                                                  hb[off][field])
+            got += 1
+        assert got == len(host_batches) > 0
+
+    def test_resume_matches_host_loader(self, tmp_path):
+        url = _write(tmp_path / 'sharded_resume', list(range(60)))
+        ngram = _ngram(2)
+        mesh = self._mesh()
+        kwargs = dict(batch_size=8, num_epochs=2, seed=9, workers_count=2)
+        full = [tuple(int(t) for t in b[0]['ts'])
+                for b in make_indexed_ngram_loader(url, ngram, **kwargs)]
+        sharded = make_indexed_ngram_loader(url, ngram, mesh=mesh, **kwargs)
+        it = iter(sharded)
+        for _ in range(3):
+            next(it)
+        state = sharded.state_dict()
+        it.close()
+        sharded.close()
+        resumed = make_indexed_ngram_loader(url, ngram, mesh=mesh, **kwargs)
+        resumed.load_state_dict(state)
+        rest = [tuple(int(t) for t in np.asarray(b[0]['ts'])) for b in resumed]
+        assert rest == full[3:]
+
+    def test_indivisible_batch_rejected(self, tmp_path):
+        url = _write(tmp_path / 'sharded_bad', list(range(30)))
+        with pytest.raises(ValueError, match='divide evenly'):
+            make_indexed_ngram_loader(url, _ngram(2), batch_size=6,
+                                      mesh=self._mesh())
+
+
 def test_feeds_lm_train_step(tmp_path):
     """Windows → concatenated sequence → one LM step (the resume-capable
     variant of the NGram → LM loop)."""
