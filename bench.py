@@ -133,13 +133,13 @@ def main():
     # at read time (the reference's sequence-model input path, SURVEY §5.7)
     ngram_chunk = 64
     ngram_rows = 8192 if on_tpu else 256
-    ngram_path = '/tmp/petastorm_tpu_northstar_ngram_{}x{}'.format(
+    ngram_path = '/tmp/petastorm_tpu_northstar_ngram_{}x{}_g256'.format(
         ngram_rows, ngram_chunk)
     ngram_url = 'file://' + ngram_path
     _ensure(ngram_path, '_common_metadata',
             lambda: northstar.generate_timeseries_token_dataset(
                 ngram_url, rows=ngram_rows, chunk=ngram_chunk,
-                row_group_size_mb=0.5))
+                rows_per_group=256))
 
     imagenet_rows = 2048 if on_tpu else 48
     imagenet_path = '{}_{}'.format(IMAGENET_PATH, imagenet_rows)
@@ -166,6 +166,9 @@ def main():
         lm = northstar.run_transformer_train_bench(
             tokens_url, batch_size=64, num_steps=40, seq_len=seq_len)
         lm_ngram = northstar.run_ngram_transformer_train_bench(
+            ngram_url, window=4, chunk=ngram_chunk, batch_size=64,
+            num_steps=40)
+        lm_ngram_indexed = northstar.run_indexed_ngram_transformer_train_bench(
             ngram_url, window=4, chunk=ngram_chunk, batch_size=64,
             num_steps=40)
         # image_size must be COVERED by the scale-2 decode of every image
@@ -196,6 +199,9 @@ def main():
             tokens_url, batch_size=8, num_steps=8, seq_len=seq_len,
             d_model=128, n_layers=2, d_ff=512)
         lm_ngram = northstar.run_ngram_transformer_train_bench(
+            ngram_url, window=2, chunk=ngram_chunk, batch_size=8,
+            num_steps=8, d_model=128, n_layers=2, d_ff=512)
+        lm_ngram_indexed = northstar.run_indexed_ngram_transformer_train_bench(
             ngram_url, window=2, chunk=ngram_chunk, batch_size=8,
             num_steps=8, d_model=128, n_layers=2, d_ff=512)
         img_decode = northstar.run_image_decode_bench(imagenet_url,
@@ -240,6 +246,7 @@ def main():
             'mnist_train_cached': mnist_cached.as_dict(),
             'transformer_train': lm.as_dict(),
             'transformer_train_ngram': lm_ngram.as_dict(),
+            'transformer_train_ngram_indexed': lm_ngram_indexed.as_dict(),
             'image_decode': img_decode,
             'imagenet_train': imagenet.as_dict(),
             'image_decode_jpeg_hinted': img_decode_jpeg,

@@ -317,12 +317,17 @@ def run_transformer_train_bench(dataset_url: str, batch_size: int = 64,
 def generate_timeseries_token_dataset(output_url: str, rows: int = 4096,
                                       chunk: int = 64, vocab: int = 8192,
                                       seed: int = 0,
-                                      row_group_size_mb: float = 0.5) -> str:
+                                      rows_per_group: int = 256) -> str:
     """Timestamped token chunks — the raw material for the NGram LM pipeline
     (SURVEY §5.7: NGram is *the* reference input pipeline for sequence
     models). Each row is one timestep: ``ts`` orders rows, ``tokens`` holds a
     fixed-size chunk; the NGram reader assembles consecutive rows into
-    windows at read time."""
+    windows at read time.
+
+    ``rows_per_group`` bounds the windows a single ventilated row group can
+    pre-assemble: a row group is the streaming bench's unit of read-ahead,
+    and huge groups would let a short measured window be served entirely
+    from warmup surplus (the r02 invariant bug, window-flavored)."""
     rng = np.random.default_rng(seed)
     schema = Unischema('TimeseriesTokens', [
         UnischemaField('ts', np.int64, (), ScalarCodec(), False),
@@ -335,8 +340,8 @@ def generate_timeseries_token_dataset(output_url: str, rows: int = 4096,
                    'tokens': rng.integers(0, vocab, size=(chunk,),
                                           dtype=np.int32)}
 
-    with materialize_dataset(output_url, schema,
-                             row_group_size_mb=row_group_size_mb) as writer:
+    with materialize_dataset(output_url, schema, row_group_size_mb=256,
+                             rows_per_file=rows_per_group) as writer:
         writer.write_rows(gen())
     return output_url
 
@@ -344,7 +349,7 @@ def generate_timeseries_token_dataset(output_url: str, rows: int = 4096,
 def run_ngram_transformer_train_bench(dataset_url: str, window: int = 4,
                                       chunk: int = 64, batch_size: int = 64,
                                       num_steps: int = 40,
-                                      warmup_steps: int = 3,
+                                      warmup_steps: int = 8,
                                       workers_count: int = None,
                                       prefetch: int = 8,
                                       d_model: int = 256, n_layers: int = 4,
@@ -387,10 +392,13 @@ def run_ngram_transformer_train_bench(dataset_url: str, window: int = 4,
             state['params'], state['opt'], chunks)
         return loss
 
+    # queue bound of 2 window-group chunks: with ~256-row groups that is a
+    # few hundred pre-assembled windows of read-ahead — drainable by the
+    # warmup steps, so the measured window is steady state
     with make_reader(dataset_url, schema_fields=ngram,
                      reader_pool_type='thread',
                      workers_count=workers_count or _default_workers(),
-                     results_queue_size=_TRAIN_BENCH_QUEUE_CHUNKS,
+                     results_queue_size=2,
                      num_epochs=None) as reader:
         loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
         batches = prefetch_batches(iter(loader), size=prefetch)
@@ -398,6 +406,70 @@ def run_ngram_transformer_train_bench(dataset_url: str, window: int = 4,
             batches, step_fn, num_steps=num_steps, warmup_steps=warmup_steps,
             count_fn=lambda b: int(b[0]['tokens'].shape[0]),
             dispatch_ahead=dispatch_ahead)
+
+
+def run_indexed_ngram_transformer_train_bench(
+        dataset_url: str, window: int = 4, chunk: int = 64,
+        batch_size: int = 64, num_steps: int = 40, warmup_steps: int = 8,
+        workers_count: int = None, prefetch: int = 8,
+        d_model: int = 256, n_layers: int = 4, n_heads: int = 8,
+        d_ff: int = 1024, vocab: int = 8192,
+        dispatch_ahead: int = 2) -> InfeedReport:
+    """The resume-capable NGram LM pipeline: the SAME window workload as
+    :func:`run_ngram_transformer_train_bench` (matched worker counts), fed
+    by the indexed window loader (vectorized per-offset gathers, O(1) exact
+    resume) instead of the streaming row-granular assembler — the pair
+    quantifies what the indexed path buys. The loader's own worker pool is
+    the prefetch pipeline (no extra wrapper), and warmup drains the
+    read-ahead built up during jit compile before the window is measured."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.indexed_ngram import make_indexed_ngram_loader
+    from petastorm_tpu.models import transformer_lm as tlm
+    from petastorm_tpu.ngram import NGram
+
+    seq_len = window * chunk - 1
+    config = tlm.TransformerConfig(vocab_size=vocab, d_model=d_model,
+                                   n_heads=n_heads, n_layers=n_layers,
+                                   d_ff=d_ff, max_seq_len=seq_len + 1)
+    params = tlm.init(jax.random.PRNGKey(0), config)
+    optimizer, step = tlm.make_train_step(config)
+    opt_state = optimizer.init(params)
+    state = {'params': params, 'opt': opt_state}
+    fields = {0: ['ts', 'tokens']}
+    fields.update({i: ['tokens'] for i in range(1, window)})
+    ngram = NGram(fields, delta_threshold=1, timestamp_field='ts')
+
+    @jax.jit
+    def concat_and_step(params, opt_state, chunks):
+        seq = jnp.concatenate(chunks, axis=1)
+        return step(params, opt_state, seq[:, :-1], seq[:, 1:])
+
+    def step_fn(batch):
+        chunks = [batch[i]['tokens'] for i in range(window)]
+        state['params'], state['opt'], loss = concat_and_step(
+            state['params'], state['opt'], chunks)
+        return loss
+
+    loader = make_indexed_ngram_loader(
+        dataset_url, ngram, batch_size=batch_size, num_epochs=1, seed=0,
+        workers_count=workers_count or _default_workers(),
+        prefetch_batches=prefetch)
+    # one index build: bump the epoch budget on the already-built loader
+    # (num_epochs is only consulted when iteration starts)
+    loader.num_epochs = max(1, math.ceil(
+        (num_steps + warmup_steps + 2) / loader.batches_per_epoch))
+    try:
+        return measure_infeed_overlap(
+            iter(loader), step_fn, num_steps=num_steps,
+            warmup_steps=warmup_steps,
+            count_fn=lambda b: int(b[0]['tokens'].shape[0]),
+            dispatch_ahead=dispatch_ahead)
+    finally:
+        loader.close()
 
 
 def run_columnar_read_bench(dataset_url: str, workers_count: int = None) -> dict:

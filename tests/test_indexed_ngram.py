@@ -319,6 +319,21 @@ class TestShardedIndexedNGram:
                                       mesh=self._mesh())
 
 
+@pytest.mark.slow
+def test_indexed_ngram_bench_runs(tmp_path):
+    """The northstar indexed-NGram LM bench drives end to end."""
+    from petastorm_tpu.benchmark.northstar import (
+        generate_timeseries_token_dataset,
+        run_indexed_ngram_transformer_train_bench)
+    url = 'file://' + str(tmp_path / 'bench_tok')
+    generate_timeseries_token_dataset(url, rows=96, chunk=16, vocab=256)
+    report = run_indexed_ngram_transformer_train_bench(
+        url, window=2, chunk=16, batch_size=4, num_steps=3, warmup_steps=1,
+        workers_count=2, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+        vocab=256)
+    assert report.steps == 3 and report.samples == 12
+
+
 def test_feeds_lm_train_step(tmp_path):
     """Windows → concatenated sequence → one LM step (the resume-capable
     variant of the NGram → LM loop)."""
