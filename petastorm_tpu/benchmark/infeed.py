@@ -10,6 +10,7 @@ compute.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
 from typing import Callable, Iterable, Optional
@@ -62,8 +63,6 @@ def measure_infeed_overlap(batch_iterator: Iterable, step_fn: Callable,
         stall. The device-time accounting is unchanged (every step is still
         blocked on before the report closes).
     """
-    import collections
-
     import jax
 
     iterator = iter(batch_iterator)

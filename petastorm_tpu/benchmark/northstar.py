@@ -346,37 +346,24 @@ def generate_timeseries_token_dataset(output_url: str, rows: int = 4096,
     return output_url
 
 
-def run_ngram_transformer_train_bench(dataset_url: str, window: int = 4,
-                                      chunk: int = 64, batch_size: int = 64,
-                                      num_steps: int = 40,
-                                      warmup_steps: int = 8,
-                                      workers_count: int = None,
-                                      prefetch: int = 8,
-                                      d_model: int = 256, n_layers: int = 4,
-                                      n_heads: int = 8, d_ff: int = 1024,
-                                      vocab: int = 8192,
-                                      dispatch_ahead: int = 2) -> InfeedReport:
-    """The full NGram → JAX → LM loop: parquet rows → NGram window assembly
-    (``make_reader(schema_fields=NGram(...))``) → per-timestep collated
-    device batches (``JaxDataLoader``) → flagship LM train step. The window's
-    timestep chunks concatenate on device into one (B, window·chunk)
-    sequence; inputs/targets shift by one token."""
+def _make_ngram_lm_parts(window: int, chunk: int, d_model: int,
+                         n_layers: int, n_heads: int, d_ff: int, vocab: int):
+    """Shared setup for the NGram LM bench pair: the window spec and a
+    ``step_fn`` that concatenates a window's timestep chunks on device into
+    one (B, window·chunk) sequence and runs the LM train step on the
+    shift-by-one (inputs, targets)."""
     import jax
     import jax.numpy as jnp
 
-    from petastorm_tpu import make_reader
-    from petastorm_tpu.jax_utils import JaxDataLoader, prefetch_batches
     from petastorm_tpu.models import transformer_lm as tlm
     from petastorm_tpu.ngram import NGram
 
-    seq_len = window * chunk - 1
     config = tlm.TransformerConfig(vocab_size=vocab, d_model=d_model,
                                    n_heads=n_heads, n_layers=n_layers,
-                                   d_ff=d_ff, max_seq_len=seq_len + 1)
+                                   d_ff=d_ff, max_seq_len=window * chunk)
     params = tlm.init(jax.random.PRNGKey(0), config)
     optimizer, step = tlm.make_train_step(config)
-    opt_state = optimizer.init(params)
-    state = {'params': params, 'opt': opt_state}
+    state = {'params': params, 'opt': optimizer.init(params)}
     fields = {0: ['ts', 'tokens']}
     fields.update({i: ['tokens'] for i in range(1, window)})
     ngram = NGram(fields, delta_threshold=1, timestamp_field='ts')
@@ -392,6 +379,27 @@ def run_ngram_transformer_train_bench(dataset_url: str, window: int = 4,
             state['params'], state['opt'], chunks)
         return loss
 
+    return ngram, step_fn
+
+
+def run_ngram_transformer_train_bench(dataset_url: str, window: int = 4,
+                                      chunk: int = 64, batch_size: int = 64,
+                                      num_steps: int = 40,
+                                      warmup_steps: int = 8,
+                                      workers_count: int = None,
+                                      prefetch: int = 8,
+                                      d_model: int = 256, n_layers: int = 4,
+                                      n_heads: int = 8, d_ff: int = 1024,
+                                      vocab: int = 8192,
+                                      dispatch_ahead: int = 2) -> InfeedReport:
+    """The full NGram → JAX → LM loop: parquet rows → NGram window assembly
+    (``make_reader(schema_fields=NGram(...))``) → per-timestep collated
+    device batches (``JaxDataLoader``) → flagship LM train step."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_utils import JaxDataLoader, prefetch_batches
+
+    ngram, step_fn = _make_ngram_lm_parts(window, chunk, d_model, n_layers,
+                                          n_heads, d_ff, vocab)
     # queue bound of 2 window-group chunks: with ~256-row groups that is a
     # few hundred pre-assembled windows of read-ahead — drainable by the
     # warmup steps, so the measured window is steady state
@@ -424,36 +432,10 @@ def run_indexed_ngram_transformer_train_bench(
     read-ahead built up during jit compile before the window is measured."""
     import math
 
-    import jax
-    import jax.numpy as jnp
-
     from petastorm_tpu.indexed_ngram import make_indexed_ngram_loader
-    from petastorm_tpu.models import transformer_lm as tlm
-    from petastorm_tpu.ngram import NGram
 
-    seq_len = window * chunk - 1
-    config = tlm.TransformerConfig(vocab_size=vocab, d_model=d_model,
-                                   n_heads=n_heads, n_layers=n_layers,
-                                   d_ff=d_ff, max_seq_len=seq_len + 1)
-    params = tlm.init(jax.random.PRNGKey(0), config)
-    optimizer, step = tlm.make_train_step(config)
-    opt_state = optimizer.init(params)
-    state = {'params': params, 'opt': opt_state}
-    fields = {0: ['ts', 'tokens']}
-    fields.update({i: ['tokens'] for i in range(1, window)})
-    ngram = NGram(fields, delta_threshold=1, timestamp_field='ts')
-
-    @jax.jit
-    def concat_and_step(params, opt_state, chunks):
-        seq = jnp.concatenate(chunks, axis=1)
-        return step(params, opt_state, seq[:, :-1], seq[:, 1:])
-
-    def step_fn(batch):
-        chunks = [batch[i]['tokens'] for i in range(window)]
-        state['params'], state['opt'], loss = concat_and_step(
-            state['params'], state['opt'], chunks)
-        return loss
-
+    ngram, step_fn = _make_ngram_lm_parts(window, chunk, d_model, n_layers,
+                                          n_heads, d_ff, vocab)
     loader = make_indexed_ngram_loader(
         dataset_url, ngram, batch_size=batch_size, num_epochs=1, seed=0,
         workers_count=workers_count or _default_workers(),

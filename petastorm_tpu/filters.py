@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
 import pyarrow.parquet as pq
 
 from petastorm_tpu.predicates import PredicateBase
@@ -116,7 +117,6 @@ def validate_filter_types(conjunctions: Sequence[Conjunction], schema,
     mid-iteration with a per-row ``TypeError`` (the reference's pyarrow path
     rejects it at dataset-open time). Partition columns are exempt — their
     string values coerce to the filter value's type."""
-    import numpy as np
     for conjunction in conjunctions:
         for col, op, val in conjunction:
             if col in partition_keys:
