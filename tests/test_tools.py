@@ -7,6 +7,24 @@ import pytest
 from petastorm_tpu.reader import make_reader
 
 
+class TestThroughputCli:
+    def test_single_run(self, scalar_dataset, capsys):
+        from petastorm_tpu.benchmark.cli import main
+        assert main([scalar_dataset.url, '-m', '20', '-n', '50',
+                     '-w', '2']) == 0
+        out = capsys.readouterr().out
+        assert 'samples/sec' in out
+        assert 'Dispersion' not in out
+
+    def test_runs_dispersion(self, scalar_dataset, capsys):
+        from petastorm_tpu.benchmark.cli import main
+        assert main([scalar_dataset.url, '-m', '20', '-n', '50', '-w', '2',
+                     '--runs', '3']) == 0
+        out = capsys.readouterr().out
+        assert 'Dispersion over 3 runs' in out
+        assert 'spread' in out
+
+
 class TestCopyDataset:
     def test_full_copy(self, synthetic_dataset, tmp_path):
         from petastorm_tpu.tools.copy_dataset import copy_dataset
