@@ -21,9 +21,11 @@ For **O(1) exact resume with any worker count** use
 (seed, epoch, index)) or, for NGram window pipelines,
 :mod:`petastorm_tpu.indexed_ngram` (``make_indexed_ngram_loader``; windows
 addressed the same way). Their cursors restore instantly and byte-exactly —
-no replay. This module remains the replay fallback for the queue-based
-streaming readers (ragged fields, weighted mixes, worker-side predicates
-over streaming pools).
+no replay. Ragged fields join in via ``make_indexed_loader(...,
+pad_spec=...)``, which pads them inside the deterministic batch function
+(``tests/test_indexed_loader.py::TestRaggedFieldsExactResume``). This module
+remains the replay fallback for the queue-based streaming readers (weighted
+mixes, worker-side predicates over streaming pools).
 """
 
 from __future__ import annotations

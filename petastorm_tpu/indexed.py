@@ -338,7 +338,7 @@ class IndexedBatchLoader:
                  num_epochs: int = 1, seed: int = 0, shuffle: bool = True,
                  shuffle_window_groups: int = 4, workers_count: int = 4,
                  prefetch_batches: int = 8, predicate=None,
-                 transform_spec=None):
+                 transform_spec=None, pad_spec=None):
         if num_epochs is None:
             raise ValueError('IndexedBatchLoader needs a finite num_epochs '
                              '(the resume cursor indexes a finite schedule)')
@@ -357,6 +357,14 @@ class IndexedBatchLoader:
             self.schema = transform_schema(dataset.schema, transform_spec)
         else:
             self.schema = dataset.schema
+        # ragged (wildcard-shape) fields pad to dense bucketed arrays inside
+        # the deterministic batch function, so exact resume covers them too
+        # (same spec grammar as JaxDataLoader; pads run AFTER transform_spec)
+        from petastorm_tpu.jax_utils import (check_pad_spec_fields,
+                                             validate_pad_spec)
+        self.pad_spec = validate_pad_spec(pad_spec)
+        check_pad_spec_fields(self.pad_spec, self.schema.fields,
+                              'IndexedBatchLoader')
         if predicate is not None:
             # The surviving row set is fixed ONCE here; the stream stays a
             # pure function of (dataset, predicate, seed, cursor), so resume
@@ -420,11 +428,14 @@ class IndexedBatchLoader:
         columnar worker via ``apply_columnar_transform``). Deterministic
         because the transform is a pure per-batch function of deterministic
         input."""
-        if self.transform_spec is None:
-            return columns
-        from petastorm_tpu.transform import apply_columnar_transform
-        return apply_columnar_transform(self.transform_spec, self.schema,
-                                        columns)
+        if self.transform_spec is not None:
+            from petastorm_tpu.transform import apply_columnar_transform
+            columns = apply_columnar_transform(self.transform_spec,
+                                               self.schema, columns)
+        if self.pad_spec:
+            from petastorm_tpu.jax_utils import pad_ragged_batch
+            columns = pad_ragged_batch(columns, self.pad_spec)
+        return columns
 
     def _assemble(self, epoch: int, batch: int) -> Dict[str, np.ndarray]:
         return self._apply_transform(
@@ -588,6 +599,8 @@ class ShardedIndexedLoader(IndexedBatchLoader):
         sharding, local_positions = sharded_batch_setup(mesh, batch_axis,
                                                         batch_size)
         super().__init__(dataset, batch_size, **kwargs)
+        from petastorm_tpu.jax_utils import require_single_bucket_pad_spec
+        require_single_bucket_pad_spec(self.pad_spec, 'ShardedIndexedLoader')
         self.mesh = mesh
         self.batch_axis = batch_axis
         self._sharding = sharding
@@ -612,7 +625,7 @@ def make_indexed_loader(dataset_url, batch_size, num_epochs=1, seed=0,
                         workers_count=4, prefetch_batches=8,
                         schema_fields=None, storage_options=None,
                         cache_groups=None, mesh=None, batch_axis='data',
-                        predicate=None, transform_spec=None):
+                        predicate=None, transform_spec=None, pad_spec=None):
     """Factory: :class:`IndexedDatasetReader` + :class:`IndexedBatchLoader`
     (host numpy batches), or :class:`ShardedIndexedLoader` (global
     ``jax.Array`` batches over ``mesh``, ``batch_size`` global).
@@ -630,7 +643,8 @@ def make_indexed_loader(dataset_url, batch_size, num_epochs=1, seed=0,
                   shuffle_window_groups=shuffle_window_groups,
                   workers_count=workers_count,
                   prefetch_batches=prefetch_batches,
-                  predicate=predicate, transform_spec=transform_spec)
+                  predicate=predicate, transform_spec=transform_spec,
+                  pad_spec=pad_spec)
     if mesh is None:
         return IndexedBatchLoader(dataset, batch_size, **kwargs)
     return ShardedIndexedLoader(dataset, batch_size, mesh=mesh,

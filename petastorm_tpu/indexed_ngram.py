@@ -126,6 +126,12 @@ class IndexedNGramLoader(IndexedBatchLoader):
                 raise ValueError('IndexedNGramLoader does not support {} '
                                  '(use the streaming NGram reader)'
                                  .format(unsupported))
+        if kwargs.get('pad_spec') is not None:
+            # no NGram path supports pad_spec anywhere (window fields are
+            # fixed-shape per timestep) — don't suggest a fallback
+            raise ValueError('IndexedNGramLoader does not support pad_spec '
+                             '(NGram window fields are fixed-shape per '
+                             'timestep)')
         ngram.resolve_regex_field_names(dataset.full_schema)
         self._ngram = ngram
         # Narrow the reader to the NGram's field universe: without this,

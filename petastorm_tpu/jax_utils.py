@@ -100,6 +100,42 @@ def validate_pad_spec(pad_spec):
     return normalized
 
 
+def check_pad_spec_fields(pad_spec, field_names, who: str) -> None:
+    """Validate a NORMALIZED pad_spec against a schema's field names: every
+    padded field must exist (a typo must fail, not silently no-op) and no
+    ``length_field`` may collide with a real column
+    (:func:`pad_ragged_batch` would silently overwrite its data). Shared by
+    the streaming and indexed loaders."""
+    if not pad_spec:
+        return
+    names = set(field_names)
+    unknown = set(pad_spec) - names
+    if unknown:
+        raise ValueError('{}: pad_spec names unknown fields {} (schema has '
+                         '{})'.format(who, sorted(unknown), sorted(names)))
+    for name, spec in pad_spec.items():
+        if spec['length_field'] in names:
+            raise ValueError(
+                "{}: pad_spec length_field {!r} for {!r} collides with an "
+                'existing column; pick another via length_field='.format(
+                    who, spec['length_field'], name))
+
+
+def require_single_bucket_pad_spec(pad_spec, loader_name: str) -> None:
+    """Sharded loaders pad each host's LOCAL sub-batch: with multiple
+    buckets, hosts can disagree on the padded width of the same global step
+    and ``make_array_from_process_local_data`` would assemble inconsistent
+    global shapes (multi-host hang). Shared by the streaming and indexed
+    sharded loaders."""
+    if not pad_spec:
+        return
+    multi = {n for n, s in pad_spec.items() if len(s['buckets']) > 1}
+    if multi:
+        raise ValueError(
+            "{} needs a single-bucket pad_spec (use 'max_len'); fields "
+            'with multiple buckets: {}'.format(loader_name, sorted(multi)))
+
+
 def pad_ragged_batch(batch, pad_spec):
     """Pad ragged (object-dtype) columns into dense bucketed arrays so
     variable-length fields can live in HBM under jit.
@@ -270,12 +306,8 @@ class JaxDataLoader(JaxLoaderBase):
         if self.pad_spec:
             schema_fields = getattr(getattr(reader, 'schema', None), 'fields', None)
             if schema_fields is not None:
-                unknown = set(self.pad_spec) - set(schema_fields)
-                if unknown:    # a typo must fail here, not silently no-op
-                    raise ValueError('pad_spec names unknown fields {} '
-                                     '(reader schema has {})'.format(
-                                         sorted(unknown),
-                                         sorted(schema_fields)))
+                check_pad_spec_fields(self.pad_spec, schema_fields,
+                                      'JaxDataLoader')
         self._cache = [] if inmemory_cache_all else None
         self._cache_complete = False
 
@@ -442,19 +474,8 @@ class ShardedJaxLoader(JaxLoaderBase):
                 'concatenated windows explicitly')
         self.mesh = mesh
         self.batch_axis = batch_axis
-        normalized_pad = validate_pad_spec(pad_spec)
-        if normalized_pad:
-            multi = {n for n, s in normalized_pad.items()
-                     if len(s['buckets']) > 1}
-            if multi:
-                # each host buckets on its own local batch: with multiple
-                # buckets, hosts can disagree on the padded width of the same
-                # global step and make_array_from_process_local_data would
-                # assemble inconsistent global shapes (multi-host hang)
-                raise ValueError(
-                    'ShardedJaxLoader needs a single-bucket pad_spec (use '
-                    "'max_len'); fields with multiple buckets: {}".format(
-                        sorted(multi)))
+        require_single_bucket_pad_spec(validate_pad_spec(pad_spec),
+                                       'ShardedJaxLoader')
         self._loader = JaxDataLoader(
             reader, batch_size=local_batch_size,
             shuffling_queue_capacity=shuffling_queue_capacity,

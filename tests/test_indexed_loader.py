@@ -411,3 +411,98 @@ class TestIndexedTransform:
         batch = next(iter(loader))
         assert set(batch.keys()) == {'vec'}
         loader.close()
+
+
+class TestRaggedFieldsExactResume:
+    """Ragged (wildcard-shape) fields compose with the indexed loader +
+    pad_ragged_batch: exact O(1) resume is NOT limited to fixed-shape
+    pipelines (round-3 weak item: ragged pipelines fell back to replay)."""
+
+    @pytest.fixture(scope='class')
+    def ragged_url(self, tmp_path_factory):
+        from petastorm_tpu.codecs import NdarrayCodec
+        schema = Unischema('Ragged', [
+            UnischemaField('idx', np.int64, (), ScalarCodec(), False),
+            UnischemaField('seq', np.int32, (None,), NdarrayCodec(), False),
+        ])
+        url = 'file://' + str(tmp_path_factory.mktemp('ragged_idx') / 'ds')
+        rng = np.random.default_rng(1)
+        rows = [{'idx': np.int64(i),
+                 'seq': rng.integers(0, 100, rng.integers(1, 9),
+                                     dtype='int64').astype(np.int32)}
+                for i in range(96)]
+        with materialize_dataset(url, schema, row_group_size_mb=0.001) as w:
+            w.write_rows(rows)
+        return url, rows
+
+    def _make(self, url, pad_spec, **kw):
+        return make_indexed_loader(url, batch_size=16, num_epochs=2, seed=5,
+                                   workers_count=2, pad_spec=pad_spec, **kw)
+
+    def test_padded_batches_dense_and_resumable(self, ragged_url):
+        url, rows = ragged_url
+        pad_spec = {'seq': {'max_len': 8, 'pad_value': -1}}
+        full = []
+        for batch in self._make(url, pad_spec):
+            assert batch['seq'].dtype == np.int32
+            assert batch['seq'].shape == (16, 8)        # dense, bucketed
+            assert batch['seq_len'].dtype == np.int32
+            # padding slots carry pad_value; real slots match the source rows
+            for r in range(16):
+                n = int(batch['seq_len'][r])
+                src = next(x for x in rows if x['idx'] == batch['idx'][r])
+                np.testing.assert_array_equal(batch['seq'][r, :n], src['seq'])
+                assert (batch['seq'][r, n:] == -1).all()
+            full.append((batch['idx'].tobytes(), batch['seq'].tobytes()))
+
+        # byte-exact mid-epoch resume of the PADDED stream
+        first = self._make(url, pad_spec)
+        it = iter(first)
+        for _ in range(3):
+            next(it)
+        state = first.state_dict()
+        it.close()
+        first.close()
+        resumed = self._make(url, pad_spec)
+        resumed.load_state_dict(state)
+        rest = [(b['idx'].tobytes(), b['seq'].tobytes()) for b in resumed]
+        assert rest == full[3:]
+
+    def test_unknown_pad_field_rejected(self, ragged_url):
+        url, _ = ragged_url
+        with pytest.raises(ValueError, match='unknown fields'):
+            self._make(url, {'nope': {'max_len': 8}})
+
+    def test_length_field_collision_rejected(self, ragged_url):
+        """The synthesized length column must not silently overwrite a real
+        schema column."""
+        url, _ = ragged_url
+        with pytest.raises(ValueError, match='collides'):
+            self._make(url, {'seq': {'max_len': 8, 'length_field': 'idx'}})
+
+    def test_sharded_multi_bucket_rejected(self, ragged_url):
+        import jax
+        from petastorm_tpu.parallel import make_mesh
+        url, _ = ragged_url
+        devices = jax.devices('cpu')
+        if len(devices) < 8:
+            pytest.skip('needs 8 CPU devices')
+        mesh = make_mesh({'data': 8}, devices=devices)
+        with pytest.raises(ValueError, match='single-bucket'):
+            self._make(url, {'seq': {'buckets': [4, 8]}}, mesh=mesh)
+
+    def test_sharded_single_bucket_pads_globally(self, ragged_url):
+        import jax
+        from petastorm_tpu.parallel import make_mesh
+        url, rows = ragged_url
+        devices = jax.devices('cpu')
+        if len(devices) < 8:
+            pytest.skip('needs 8 CPU devices')
+        mesh = make_mesh({'data': 8}, devices=devices)
+        loader = self._make(url, {'seq': {'max_len': 8, 'pad_value': -1}},
+                            mesh=mesh)
+        batch = next(iter(loader))
+        assert isinstance(batch['seq'], jax.Array)
+        assert batch['seq'].shape == (16, 8)
+        assert batch['seq_len'].shape == (16,)
+        loader.close()
