@@ -419,7 +419,7 @@ def run_ngram_transformer_train_bench(dataset_url: str, window: int = 4,
 def run_indexed_ngram_transformer_train_bench(
         dataset_url: str, window: int = 4, chunk: int = 64,
         batch_size: int = 64, num_steps: int = 40, warmup_steps: int = 8,
-        workers_count: int = None, prefetch: int = 8,
+        workers_count: int = None, prefetch: int = 16,
         d_model: int = 256, n_layers: int = 4, n_heads: int = 8,
         d_ff: int = 1024, vocab: int = 8192,
         dispatch_ahead: int = 2) -> InfeedReport:
@@ -429,7 +429,12 @@ def run_indexed_ngram_transformer_train_bench(
     resume) instead of the streaming row-granular assembler — the pair
     quantifies what the indexed path buys. The loader's own worker pool is
     the prefetch pipeline (no extra wrapper), and warmup drains the
-    read-ahead built up during jit compile before the window is measured."""
+    read-ahead built up during jit compile before the window is measured.
+
+    ``prefetch=16`` absorbs the bench host's scheduling jitter (fused
+    assembly sustains 3-4x the step consumption rate, so the depth is
+    jitter head-room, not a warmup-surplus reservoir — verified r05 with an
+    80-step window at unchanged overlap)."""
     import math
 
     from petastorm_tpu.indexed_ngram import make_indexed_ngram_loader

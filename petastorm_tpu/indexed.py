@@ -89,7 +89,9 @@ class IndexedDatasetReader:
         self.row_offsets = np.concatenate([[0], np.cumsum(counts)])
         self.total_rows = int(self.row_offsets[-1])
 
-        self._cache: 'collections.OrderedDict[int, Dict[str, np.ndarray]]' = \
+        # keyed by (piece_index, fields-tuple-or-None): narrowed and full
+        # reads of one piece never alias
+        self._cache: 'collections.OrderedDict[tuple, Dict[str, np.ndarray]]' = \
             collections.OrderedDict()
         self._cache_groups = cache_groups
         self._lock = threading.Lock()
@@ -135,14 +137,24 @@ class IndexedDatasetReader:
     def __exit__(self, exc_type, exc_val, exc_tb):
         self.close()
 
-    def read_piece(self, piece_index: int) -> Dict[str, np.ndarray]:
+    def read_piece(self, piece_index: int,
+                   fields: Optional[tuple] = None) -> Dict[str, np.ndarray]:
+        """Decoded columns of row group ``piece_index``.
+
+        ``fields`` (a tuple of names from the FULL schema) narrows the read
+        to those columns — callers like the NGram window loader read a
+        different column set than the dataset's output view without mutating
+        shared state; the LRU cache keys on (piece, fields) so narrowed and
+        full reads never alias."""
+        cache_key = (piece_index, fields)
         with self._lock:
-            cached = self._cache.get(piece_index)
+            cached = self._cache.get(cache_key)
             if cached is not None:
-                self._cache.move_to_end(piece_index)
+                self._cache.move_to_end(cache_key)
                 return cached
         piece = self.pieces[piece_index]
-        names = list(self.schema.fields.keys())
+        lookup = self.schema.fields if fields is None else self.full_schema.fields
+        names = list(self.schema.fields.keys()) if fields is None else list(fields)
         partition_keys = set(piece.partition_dict.keys())
         stored = [n for n in names if n not in partition_keys]
         table = self._parquet_file(piece.path).read_row_group(
@@ -151,11 +163,11 @@ class IndexedDatasetReader:
         for name in names:
             if name in table.column_names:
                 columns[name] = _column_to_numpy(table.column(name),
-                                                 self.schema.fields[name])
+                                                 lookup[name])
         from petastorm_tpu.utils import cast_partition_value
         for key, value in piece.partition_dict.items():
-            if key in self.schema.fields:
-                field = self.schema.fields[key]
+            if key in lookup and (fields is None or key in names):
+                field = lookup[key]
                 typed = cast_partition_value(field.numpy_dtype, value)
                 if isinstance(typed, str):
                     col = np.empty(table.num_rows, dtype=object)
@@ -164,20 +176,24 @@ class IndexedDatasetReader:
                     col = np.full(table.num_rows, typed)
                 columns[key] = col
         with self._lock:
-            self._cache[piece_index] = columns
+            self._cache[cache_key] = columns
             while len(self._cache) > self._cache_groups:
                 self._cache.popitem(last=False)
         return columns
 
-    def gather(self, global_rows: np.ndarray) -> Dict[str, np.ndarray]:
-        """Decoded columns for the given global row indices, in order."""
+    def gather(self, global_rows: np.ndarray,
+               fields: Optional[tuple] = None) -> Dict[str, np.ndarray]:
+        """Decoded columns for the given global row indices, in order.
+
+        ``fields`` narrows the read to those columns (see
+        :meth:`read_piece`)."""
         piece_ids = np.searchsorted(self.row_offsets, global_rows,
                                     side='right') - 1
         local = global_rows - self.row_offsets[piece_ids]
         out: Dict[str, np.ndarray] = {}
         for p in np.unique(piece_ids):
             mask = piece_ids == p
-            cols = self.read_piece(int(p))
+            cols = self.read_piece(int(p), fields)
             idx = local[mask]
             for name, col in cols.items():
                 if name not in out:

@@ -13,9 +13,10 @@ timestamp deltas all stay within ``delta_threshold`` (with
 ``timestamp_overlap=False``, a greedy left-to-right selection of
 non-overlapping windows — exactly ``NGram.form_ngram_dicts``'s semantics,
 reference ``petastorm/ngram.py:225-270``). The index is built once from a
-timestamp-column-only scan; batches then assemble through per-offset
-:meth:`IndexedDatasetReader.gather` calls, so the row-group LRU cache is
-shared across a window's timesteps.
+timestamp-column-only scan; each batch then assembles through ONE fused
+:meth:`IndexedDatasetReader.gather` over the rows of every offset (a window
+never crosses a row group, so all timesteps share the same row-group LRU
+cache entries).
 
 Batches arrive **pre-collated** in the JAX adapter's NGram layout:
 ``{offset: {field: (B, ...) array}}`` — the same shape
@@ -134,46 +135,65 @@ class IndexedNGramLoader(IndexedBatchLoader):
                              'timestep)')
         ngram.resolve_regex_field_names(dataset.full_schema)
         self._ngram = ngram
-        # Narrow the reader to the NGram's field universe: without this,
-        # read_piece would decode — and every per-offset gather would
-        # batch-materialize — every column of a wide store, only for the
-        # per-timestep filter to drop them.
+        # Read only the NGram's field universe: without this, read_piece
+        # would decode — and every gather would batch-materialize — every
+        # column of a wide store, only for the per-timestep filter to drop
+        # them. The narrowing stays ON THE LOADER (an explicit column list
+        # threaded through gather), so a dataset shared with other loaders
+        # keeps its schema intact.
         used = [n for n in ngram.get_all_field_names()
                 if n in dataset.full_schema.fields]
-        dataset.schema = dataset.full_schema.create_schema_view(
-            [dataset.full_schema.fields[n] for n in used])
+        self._read_fields = tuple(used)
         self._offsets = sorted(ngram.fields.keys())
         self._base_offset = self._offsets[0]
         self._fields_at = {
             off: [n for n in ngram.get_field_names_at_timestep(off)
-                  if n in dataset.schema.fields]
+                  if n in used]
             for off in self._offsets}
+        # fused-gather slices are views into the (n_offsets*B, ...) base
+        # array; a field exposed at every offset covers its base entirely,
+        # but a field exposed at FEW offsets (an image at offset 0 of a long
+        # window) would pin n_offsets/1 times the useful memory for the
+        # batch's buffered lifetime — those slices are copied out instead
+        present_count: Dict[str, int] = {}
+        for names in self._fields_at.values():
+            for n in names:
+                present_count[n] = present_count.get(n, 0) + 1
+        self._copy_fields = {n for n, c in present_count.items()
+                             if c < len(self._offsets)}
         span = ngram.length
 
         ts_per_piece = _scan_timestamps(dataset, ngram.timestamp_field_name)
-        self._win_starts: List[np.ndarray] = []
-        self._sort_idx: List[Optional[np.ndarray]] = []
+        win_starts: List[np.ndarray] = []
         counts = []
-        for ts in ts_per_piece:
+        # sorted-position -> global row, flattened over pieces: entry
+        # row_offsets[p] + s is the global row index of the s-th
+        # timestamp-sorted row of piece p. One vectorized lookup replaces the
+        # per-window Python loops of the round-4 assembler.
+        pos_to_row = np.empty(dataset.total_rows, np.int64)
+        for p, ts in enumerate(ts_per_piece):
             order = np.argsort(ts, kind='stable')
-            if np.array_equal(order, np.arange(len(ts))):
-                order_opt, ts_sorted = None, ts
-            else:
-                order_opt, ts_sorted = order, ts[order]
-            starts = _valid_window_starts(ts_sorted, span,
+            lo = dataset.row_offsets[p]
+            pos_to_row[lo:lo + len(ts)] = lo + order
+            starts = _valid_window_starts(ts[order], span,
                                           ngram.delta_threshold,
                                           ngram.timestamp_overlap)
-            self._win_starts.append(starts)
-            self._sort_idx.append(order_opt)
+            win_starts.append(starts)
             counts.append(len(starts))
-        win_offsets = np.concatenate(
-            [[0], np.cumsum(np.asarray(counts, np.int64))])
+        self._pos_to_row = pos_to_row
+        counts = np.asarray(counts, np.int64)
+        win_offsets = np.concatenate([[0], np.cumsum(counts)])
+        # global window id -> (piece id, ts-sorted start position): flat
+        # arrays so _assemble never loops in Python
+        self._win_piece = np.repeat(np.arange(len(counts), dtype=np.int64),
+                                    counts)
+        self._flat_starts = (np.concatenate(win_starts) if win_starts
+                             else np.empty(0, np.int64))
 
         super().__init__(dataset, batch_size, **kwargs)
         # re-point the deterministic addressing at the WINDOW universe: the
         # permutation shuffles windows (grouped by piece), not rows
         self.total_rows = int(win_offsets[-1])       # total windows
-        self._win_offsets = win_offsets
         self._perm_offsets = win_offsets
         self.batches_per_epoch = self.total_rows // batch_size
         if self.batches_per_epoch == 0:
@@ -186,25 +206,26 @@ class IndexedNGramLoader(IndexedBatchLoader):
         return self.total_rows
 
     def _assemble(self, epoch: int, batch: int) -> Dict[int, Dict[str, np.ndarray]]:
+        """One fused gather per batch: the rows of ALL offsets share row
+        groups by construction (a window never crosses a piece), so gathering
+        the ``(n_offsets, B)`` row matrix in one call amortizes the per-gather
+        searchsorted/unique/cache-lock overhead that serialized the round-4
+        per-offset loop (the 83.75%-overlap stall in BENCH_r04)."""
         win_ids = self._batch_rows(epoch, batch)     # global window indices
-        piece_ids = np.searchsorted(self._win_offsets, win_ids,
-                                    side='right') - 1
-        local_win = win_ids - self._win_offsets[piece_ids]
-        starts = np.asarray(
-            [self._win_starts[p][w] for p, w in zip(piece_ids, local_win)],
-            np.int64)
-        row_offsets = self._dataset.row_offsets
+        piece_ids = self._win_piece[win_ids]
+        # global ts-sorted position of each window's base row
+        base_pos = self._dataset.row_offsets[piece_ids] + self._flat_starts[win_ids]
+        rel = np.asarray(self._offsets, np.int64) - self._base_offset
+        rows = self._pos_to_row[(base_pos[None, :] + rel[:, None]).ravel()]
+        cols = self._dataset.gather(rows, self._read_fields)
+        n = len(win_ids)
         out: Dict[int, Dict[str, np.ndarray]] = {}
-        for offset in self._offsets:
-            pos = starts + (offset - self._base_offset)   # ts-sorted position
-            rows = np.empty(len(pos), np.int64)
-            for i, (p, s) in enumerate(zip(piece_ids, pos)):
-                order = self._sort_idx[p]
-                local_row = int(s) if order is None else int(order[s])
-                rows[i] = row_offsets[p] + local_row
-            cols = self._dataset.gather(rows)
-            out[int(offset)] = {n: cols[n] for n in self._fields_at[offset]
-                                if n in cols}
+        for i, offset in enumerate(self._offsets):
+            sl = slice(i * n, (i + 1) * n)
+            out[int(offset)] = {
+                name: (cols[name][sl].copy() if name in self._copy_fields
+                       else cols[name][sl])
+                for name in self._fields_at[offset] if name in cols}
         return out
 
 

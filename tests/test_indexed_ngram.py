@@ -249,7 +249,10 @@ def test_reader_narrowed_to_ngram_fields(tmp_path):
     ngram = _ngram(fields={0: ['ts', 'label'], 1: ['label']})
     loader = make_indexed_ngram_loader(url, ngram, batch_size=4,
                                        num_epochs=1, shuffle=False)
-    assert set(loader._dataset.schema.fields) == {'ts', 'label'}
+    # narrowing lives on the loader (explicit gather columns), NOT as a
+    # mutation of the possibly-shared dataset's schema
+    assert set(loader._read_fields) == {'ts', 'label'}
+    assert set(loader._dataset.schema.fields) == {'ts', 'label', 'value'}
     batch = next(iter(loader))
     assert set(batch[0].keys()) == {'ts', 'label'}
     assert set(batch[1].keys()) == {'label'}
