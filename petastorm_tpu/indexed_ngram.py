@@ -32,7 +32,7 @@ import numpy as np
 
 from petastorm_tpu.errors import NoDataAvailableError
 from petastorm_tpu.indexed import IndexedBatchLoader, IndexedDatasetReader
-from petastorm_tpu.ngram import NGram
+from petastorm_tpu.ngram import NGram, valid_window_starts
 from petastorm_tpu.readers.columnar_worker import _column_to_numpy
 
 logger = logging.getLogger(__name__)
@@ -78,34 +78,6 @@ def _scan_timestamps(dataset: IndexedDatasetReader, ts_name: str) -> List[np.nda
     return out
 
 
-def _valid_window_starts(ts_sorted: np.ndarray, span: int, delta_threshold,
-                         timestamp_overlap: bool) -> np.ndarray:
-    """Start positions (in ts-sorted order) of all valid windows — the
-    vectorized equivalent of ``NGram.form_ngram_dicts``'s scan."""
-    n = len(ts_sorted)
-    if n < span:
-        return np.empty(0, np.int64)
-    if span == 1:
-        starts = np.arange(n, dtype=np.int64)
-    else:
-        gap_ok = (np.diff(ts_sorted) <= delta_threshold).astype(np.int32)
-        cum = np.concatenate([[0], np.cumsum(gap_ok)])
-        # valid[s] <=> all of gap_ok[s : s+span-1]
-        valid = (cum[span - 1:] - cum[:n - span + 1]) == span - 1
-        starts = np.nonzero(valid)[0].astype(np.int64)
-    if timestamp_overlap or not len(starts):
-        return starts
-    # greedy non-overlapping selection; skipped-invalid windows do not
-    # advance the previous-end marker (matches the streaming scan)
-    keep = []
-    previous_end = None
-    for s in starts:
-        if previous_end is None or ts_sorted[s] > previous_end:
-            keep.append(s)
-            previous_end = ts_sorted[s + span - 1]
-    return np.asarray(keep, np.int64)
-
-
 class IndexedNGramLoader(IndexedBatchLoader):
     """Deterministic NGram window batches with O(1) exact resume.
 
@@ -144,12 +116,8 @@ class IndexedNGramLoader(IndexedBatchLoader):
         used = [n for n in ngram.get_all_field_names()
                 if n in dataset.full_schema.fields]
         self._read_fields = tuple(used)
-        self._offsets = sorted(ngram.fields.keys())
-        self._base_offset = self._offsets[0]
-        self._fields_at = {
-            off: [n for n in ngram.get_field_names_at_timestep(off)
-                  if n in used]
-            for off in self._offsets}
+        self._offsets, self._base_offset, self._fields_at = \
+            ngram.timestep_layout(set(used))
         # fused-gather slices are views into the (n_offsets*B, ...) base
         # array; a field exposed at every offset covers its base entirely,
         # but a field exposed at FEW offsets (an image at offset 0 of a long
@@ -175,9 +143,9 @@ class IndexedNGramLoader(IndexedBatchLoader):
             order = np.argsort(ts, kind='stable')
             lo = dataset.row_offsets[p]
             pos_to_row[lo:lo + len(ts)] = lo + order
-            starts = _valid_window_starts(ts[order], span,
-                                          ngram.delta_threshold,
-                                          ngram.timestamp_overlap)
+            starts = valid_window_starts(ts[order], span,
+                                         ngram.delta_threshold,
+                                         ngram.timestamp_overlap)
             win_starts.append(starts)
             counts.append(len(starts))
         self._pos_to_row = pos_to_row

@@ -335,7 +335,10 @@ class JaxDataLoader(JaxLoaderBase):
         if self.reader.batched_output:
             gen = self._iter_batched()
         elif self._ngram is not None:
-            gen = self._iter_ngram()
+            if getattr(self.reader, 'ngram_chunked', False):
+                gen = self._iter_ngram_chunked()
+            else:
+                gen = self._iter_ngram()
         else:
             gen = self._iter_rows()
         for batch in gen:
@@ -349,28 +352,69 @@ class JaxDataLoader(JaxLoaderBase):
         if self._cache is not None:
             self._cache_complete = True
 
-    def _iter_batched(self):
+    def _drive_batched_buffer(self, column_stream, post=None):
+        """Shared batched-buffer loop: feed column dicts, drain fixed-size
+        batches, honor ``drop_last`` on the tail. ``post`` maps each
+        retrieved batch (the chunked NGram path unflattens its keys)."""
+        post = post or (lambda b: b)
         buffer = self._make_batched_buffer()
-        for chunk in self.reader:
-            columns = sanitize_jax_types(chunk._asdict()
-                                         if hasattr(chunk, '_asdict') else dict(chunk))
+        for columns in column_stream:
             while not buffer.can_add():
-                yield buffer.retrieve()
+                yield post(buffer.retrieve())
             buffer.add_many(columns)
             while buffer.can_retrieve() and buffer.size >= self.batch_size:
-                yield buffer.retrieve()
+                yield post(buffer.retrieve())
         buffer.finish()
         while buffer.can_retrieve():
             batch = buffer.retrieve()
             n = len(next(iter(batch.values())))
             if n == self.batch_size or not self.drop_last:
-                yield batch
+                yield post(batch)
+
+    def _iter_batched(self):
+        def columns():
+            for chunk in self.reader:
+                yield sanitize_jax_types(
+                    chunk._asdict() if hasattr(chunk, '_asdict') else dict(chunk))
+        return self._drive_batched_buffer(columns())
 
     def _iter_rows(self):
         def prepare(row):
             return sanitize_jax_types(row._asdict()
                                       if hasattr(row, '_asdict') else dict(row))
         return self._iter_row_stream(prepare, self._collate)
+
+    def _iter_ngram_chunked(self):
+        """Vectorized NGram batching: whole columnar window chunks
+        (``Reader.iter_ngram_chunks``) collate with one fancy-index per
+        (offset, field) per chunk and batch through the BATCHED buffers under
+        flattened ``(offset, field)`` keys — zero per-window Python, the
+        consumer-side twin of the worker's columnar window path. Windows
+        still shuffle as whole units: the batched buffer permutes rows (=
+        windows) with one permutation across all columns, so timestep
+        alignment survives. Yields the same ``{offset: {field: (B, ...)}}``
+        layout as :meth:`_iter_ngram`."""
+        offsets, base, fields_at = self._ngram.timestep_layout(
+            self.reader.schema.fields)
+
+        def collate_chunks():
+            for chunk in self.reader.iter_ngram_chunks():
+                flat = {}
+                for off in offsets:
+                    pos = chunk.starts + (off - base)
+                    for name in fields_at[off]:
+                        col = chunk.columns.get(name)
+                        if col is not None:
+                            flat[(off, name)] = _sanitize_value(col[pos])
+                yield flat
+
+        def unflatten(batch):
+            out = {}
+            for (off, name), col in batch.items():
+                out.setdefault(off, {})[name] = col
+            return out
+
+        return self._drive_batched_buffer(collate_chunks(), post=unflatten)
 
     def _iter_ngram(self):
         """NGram windows ({offset: namedtuple}) → per-timestep collated

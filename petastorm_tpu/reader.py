@@ -384,6 +384,14 @@ class Reader:
         pool.start(worker_class, worker_args, self._ventilator)
         self._results_reader = results_reader_factory(transformed_schema, self.ngram)
         self._stopped = False
+        #: True when every published NGram item is a columnar
+        #: :class:`~petastorm_tpu.ngram.NGramWindowChunk` (no per-row
+        #: predicate/transform/filters work item exists) — the JAX loader's
+        #: vectorized collation path keys off this.
+        self.ngram_chunked = (self.ngram is not None
+                              and transform_spec is None
+                              and worker_predicate is None
+                              and filters_predicate is None)
 
     @property
     def batched_output(self) -> bool:
@@ -482,6 +490,29 @@ class Reader:
 
     def next(self):
         return self.__next__()
+
+    def iter_ngram_chunks(self):
+        """Yield raw :class:`~petastorm_tpu.ngram.NGramWindowChunk`s (one per
+        row-group work item) instead of per-window namedtuples — the
+        zero-per-window-Python feed for vectorized batch collation. Only
+        available when :attr:`ngram_chunked`; do not interleave with
+        ``next()`` on the same pass."""
+        if not self.ngram_chunked:
+            # plain method (not a generator) so misuse fails HERE, not at the
+            # consumer's first next() in some other component
+            raise RuntimeError(
+                'iter_ngram_chunks() needs a chunk-mode NGram reader (no '
+                'predicate/transform_spec/filters); iterate per-window '
+                'instead')
+
+        def chunks():
+            while True:
+                try:
+                    yield self._results_reader.read_next_chunk(self._pool)
+                except EmptyResultError:
+                    self.last_row_consumed = True
+                    return
+        return chunks()
 
     def reset(self):
         """Restart iteration for another ``num_epochs`` pass; only legal after

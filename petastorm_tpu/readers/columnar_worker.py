@@ -222,18 +222,11 @@ class ColumnarWorker(ParquetPieceWorker):
 
     # -- loading ---------------------------------------------------------------
 
+    # _decode_table comes from ParquetPieceWorker (shared with the row
+    # worker's columnar window path)
+
     def _partition_columns(self, piece, n: int, names) -> Dict[str, np.ndarray]:
         return make_partition_columns(self._full_schema, piece, n, names)
-
-    def _decode_table(self, table: pa.Table, names) -> Dict[str, np.ndarray]:
-        out = {}
-        for name in names:
-            if name not in table.column_names:
-                continue
-            field = self._full_schema.fields[name]
-            out[name] = _column_to_numpy(table.column(name), field,
-                                         self._decode_overrides.get(name))
-        return out
 
     def _load(self, piece) -> Dict[str, np.ndarray]:
         names = list(self._schema.fields.keys())

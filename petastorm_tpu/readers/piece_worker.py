@@ -46,6 +46,20 @@ class ParquetPieceWorker(WorkerBase):
         partition_keys = set(piece.partition_dict.keys())
         return [n for n in names if n not in partition_keys]
 
+    def _decode_table(self, table, names) -> Dict:
+        """Arrow table -> decoded numpy columns for ``names`` (full-schema
+        typed, honoring per-field decode overrides) — the one columnar decode
+        shared by the columnar worker and the row worker's window path."""
+        from petastorm_tpu.readers.columnar_worker import _column_to_numpy
+        out = {}
+        for name in names:
+            if name not in table.column_names:
+                continue
+            field = self._full_schema.fields[name]
+            out[name] = _column_to_numpy(table.column(name), field,
+                                         self._decode_overrides.get(name))
+        return out
+
     def _cache_key(self, prefix: str, piece) -> str:
         # decode_hints change what a decoded row group contains (e.g. image
         # resolution) — they must partition the cache, or a reader with

@@ -13,9 +13,8 @@ from petastorm_tpu import make_reader
 from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
 from petastorm_tpu.errors import NoDataAvailableError
 from petastorm_tpu.etl.dataset_metadata import materialize_dataset
-from petastorm_tpu.indexed_ngram import (_valid_window_starts,
-                                         make_indexed_ngram_loader)
-from petastorm_tpu.ngram import NGram
+from petastorm_tpu.indexed_ngram import make_indexed_ngram_loader
+from petastorm_tpu.ngram import NGram, valid_window_starts
 from petastorm_tpu.unischema import Unischema, UnischemaField
 
 SeqSchema = Unischema('SeqSchema', [
@@ -82,25 +81,25 @@ def _window_key(w, ngram):
 def test_valid_starts_contiguous():
     ts = np.arange(10)
     np.testing.assert_array_equal(
-        _valid_window_starts(ts, 3, 1, True), np.arange(8))
+        valid_window_starts(ts, 3, 1, True), np.arange(8))
 
 
 def test_valid_starts_gap_rejected():
     ts = np.asarray([0, 1, 2, 10, 11, 12])
     np.testing.assert_array_equal(
-        _valid_window_starts(ts, 3, 1, True), [0, 3])
+        valid_window_starts(ts, 3, 1, True), [0, 3])
 
 
 def test_valid_starts_non_overlapping_greedy():
     ts = np.arange(10)
     # span 3, no overlap: windows at 0, 3, 6 (ts ranges [0-2], [3-5], [6-8])
     np.testing.assert_array_equal(
-        _valid_window_starts(ts, 3, 1, False), [0, 3, 6])
+        valid_window_starts(ts, 3, 1, False), [0, 3, 6])
 
 
 def test_valid_starts_span_one():
     np.testing.assert_array_equal(
-        _valid_window_starts(np.asarray([5, 9]), 1, 1, True), [0, 1])
+        valid_window_starts(np.asarray([5, 9]), 1, 1, True), [0, 1])
 
 
 # ---------------------------------------------------------------------------
