@@ -500,6 +500,11 @@ class ShardedJaxLoader(JaxLoaderBase):
 
     String/object columns cannot live in HBM; they are returned under
     ``batch['_host']`` untouched.
+
+    NGram readers are supported: each step yields the nested
+    ``{offset: {field: global jax.Array}}`` layout, every timestep's columns
+    sharded over ``batch_axis`` at WINDOW granularity (``local_batch_size``
+    windows per process), with the same lockstep-stop protocol.
     """
 
     def __init__(self, reader, mesh, local_batch_size, batch_axis='data',
@@ -507,15 +512,10 @@ class ShardedJaxLoader(JaxLoaderBase):
                  inmemory_cache_all=False, pad_spec=None):
         super(ShardedJaxLoader, self).__init__(reader)
         from jax.sharding import NamedSharding, PartitionSpec
-        if getattr(reader, 'ngram', None) is not None:
-            # NGram batches are nested {offset: {field: array}} dicts;
-            # stage_to_global stages flat columns — without this guard the
-            # nested dicts would silently land under batch['_host'] with no
-            # global arrays at all
-            raise NotImplementedError(
-                'ShardedJaxLoader does not support NGram readers; use '
-                'JaxDataLoader + prefetch_to_device and shard the '
-                'concatenated windows explicitly')
+        # NGram batches are nested {offset: {field: array}}; each timestep's
+        # columns stage into global arrays per offset (window batches shard
+        # over the batch axis exactly like row batches)
+        self._ngram = getattr(reader, 'ngram', None)
         self.mesh = mesh
         self.batch_axis = batch_axis
         require_single_bucket_pad_spec(validate_pad_spec(pad_spec),
@@ -545,16 +545,29 @@ class ShardedJaxLoader(JaxLoaderBase):
                 # at the shortest host's stream; a surplus local batch is
                 # dropped (the multi-host extension of drop_last).
                 if not _all_processes_ready(batch is not None):
-                    # Drain the surplus before stopping: abandoning the inner
-                    # generator mid-stream would leave the epoch cache
-                    # incomplete and the Reader unfinished (reset() would
-                    # refuse), breaking the NEXT pass on this host only.
-                    for _ in it:
-                        pass
+                    # Drain the surplus before stopping: abandoning the
+                    # stream mid-epoch would leave the Reader unfinished
+                    # (reset() would refuse), breaking the NEXT pass on this
+                    # host only. With the epoch cache on, the inner generator
+                    # must run to completion (the cache replays these
+                    # batches); otherwise discard raw pool results without
+                    # decoding/collating them (heavily unbalanced shards
+                    # would pay full window/batch assembly for data nobody
+                    # reads).
+                    drain = getattr(self.reader, 'drain', None)
+                    if self._loader.inmemory_cache_all or drain is None:
+                        for _ in it:
+                            pass
+                    else:
+                        drain()
                     return
             elif batch is None:
                 return
-            yield stage_to_global(batch, self._named_sharding)
+            if self._ngram is not None:
+                yield {off: stage_to_global(cols, self._named_sharding)
+                       for off, cols in batch.items()}
+            else:
+                yield stage_to_global(batch, self._named_sharding)
 
 
 def _all_processes_ready(local_ready: bool) -> bool:

@@ -514,6 +514,21 @@ class Reader:
                     return
         return chunks()
 
+    def drain(self):
+        """Consume the rest of the stream WITHOUT decoding/collating on the
+        consumer side (published items are discarded as-is), leaving the
+        reader resettable. Used by the sharded loader's lockstep stop: a host
+        whose shard has surplus batches discards them raw instead of paying
+        window/batch assembly for data nobody reads."""
+        discard = getattr(self._results_reader, 'discard_buffered', None)
+        if discard is not None:
+            discard()
+        try:
+            while True:
+                self._pool.get_results()
+        except EmptyResultError:
+            self.last_row_consumed = True
+
     def reset(self):
         """Restart iteration for another ``num_epochs`` pass; only legal after
         the previous pass fully drained (reference ``reader.py:468-492``)."""

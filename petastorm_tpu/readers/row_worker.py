@@ -73,6 +73,12 @@ class RowGroupResultsReader:
             return self._ngram.make_namedtuples(item, self._schema)
         return self._schema.make_namedtuple(**item)
 
+    def discard_buffered(self):
+        """Drop windows buffered from a partially-consumed published item —
+        ``Reader.drain()`` must leave nothing stale for the next pass."""
+        with self._lock:
+            self._buffer = []
+
     def read_next_chunk(self, pool):
         """One published item, raw — the JAX loader's chunked NGram path pulls
         whole :class:`NGramWindowChunk`s and collates them vectorized. Only

@@ -1,0 +1,86 @@
+"""Child process for the real multi-process STREAMING NGRAM test.
+
+Launched by ``tests/test_multihost_process.py`` with::
+
+    python multihost_ngram_child.py <coordinator> <num_processes> \
+        <process_id> <dataset_url> <local_batch_size> <num_epochs>
+
+Each process joins a real ``jax.distributed`` cluster (CPU backend, 2 local
+virtual devices), builds ``make_reader(schema_fields=NGram(...),
+shard_by_jax_process=True)`` → ``ShardedJaxLoader`` over the global mesh,
+and prints per step::
+
+    STEP <pass> <sha256 over all offsets' global columns> LOCAL <local window-start ts ids>
+
+Global digests must agree across processes (identically assembled nested
+global batches); LOCAL window-start ids must be disjoint (row-group
+sharding); and STEP counts must match on every process even with unbalanced
+shards (lockstep stop on the nested layout).
+"""
+
+import hashlib
+import os
+import sys
+
+import numpy as np
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+_kept = [f for f in os.environ.get('XLA_FLAGS', '').split()
+         if not f.startswith('--xla_force_host_platform_device_count')]
+os.environ['XLA_FLAGS'] = ' '.join(
+    _kept + ['--xla_force_host_platform_device_count=2'])
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..'))
+
+
+def main():
+    (coordinator, num_processes, process_id, dataset_url, local_batch,
+     num_epochs) = sys.argv[1:7]
+    import jax
+    jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=int(num_processes),
+                               process_id=int(process_id))
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_utils import ShardedJaxLoader
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.parallel import make_mesh
+
+    assert jax.process_count() == int(num_processes)
+    mesh = make_mesh({'data': len(jax.devices())})
+    replicate = jax.jit(lambda x: x,
+                        out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+    ngram = NGram({0: ['ts', 'tokens'], 1: ['tokens']}, delta_threshold=1,
+                  timestamp_field='ts')
+    with make_reader(dataset_url, schema_fields=ngram,
+                     shard_by_jax_process=True, shuffle_row_groups=False,
+                     num_epochs=int(num_epochs), reader_pool_type='thread',
+                     workers_count=2) as reader:
+        loader = ShardedJaxLoader(reader, mesh,
+                                  local_batch_size=int(local_batch))
+        steps = 0
+        # two passes: the second exercises drain-then-reset on the host whose
+        # surplus window batch was dropped by the lockstep-stop protocol
+        for pass_idx in range(2):
+            for batch in loader:
+                local = np.sort(np.concatenate(
+                    [np.asarray(s.data).ravel()
+                     for s in batch[0]['ts'].addressable_shards]))
+                h = hashlib.sha256()
+                for off in sorted(batch):
+                    for name in sorted(batch[off]):
+                        full = replicate(batch[off][name])
+                        h.update(np.ascontiguousarray(
+                            np.asarray(full.addressable_data(0))).tobytes())
+                print('STEP {} {} LOCAL {}'.format(
+                    pass_idx, h.hexdigest()[:24],
+                    ','.join(str(int(i)) for i in local)), flush=True)
+                steps += 1
+    print('DONE {}'.format(steps), flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -169,9 +169,11 @@ def test_ngram_to_lm_train_step(tmp_path):
     assert report.samples == 12
 
 
-def test_sharded_loader_rejects_ngram(seq_dataset):
-    """stage_to_global stages flat columns; nested NGram batches would land
-    silently under batch['_host'] — refuse at construction."""
+def test_sharded_loader_stages_ngram_batches(seq_dataset):
+    """ShardedJaxLoader on an NGram reader yields nested {offset: {field:
+    global jax.Array}} batches sharded at window granularity over the mesh
+    (single process here; the real 2-process run lives in
+    ``test_multihost_process.py::test_streaming_sharded_ngram_two_processes``)."""
     import jax
     from jax.sharding import Mesh
 
@@ -179,12 +181,27 @@ def test_sharded_loader_rejects_ngram(seq_dataset):
 
     url, _ = seq_dataset
     mesh = Mesh(np.array(jax.devices()[:2]), ('data',))
-    with make_reader(url, schema_fields=_ngram(2),
+    with make_reader(url, schema_fields=_ngram(2), shuffle_row_groups=False,
                      reader_pool_type='dummy', num_epochs=1) as reader:
-        with pytest.raises(NotImplementedError, match='NGram'):
-            ShardedJaxLoader(reader, mesh, local_batch_size=2)
-        reader.stop()
-        reader.join()
+        loader = ShardedJaxLoader(reader, mesh, local_batch_size=4)
+        seen_windows = 0
+        for batch in loader:
+            assert sorted(batch.keys()) == [0, 1]
+            for off in (0, 1):
+                arr = batch[off]['ts']
+                assert isinstance(arr, jax.Array)
+                assert arr.shape[0] == 4
+                assert batch[off]['value'].shape == (4, 3)
+            ts0 = np.asarray(batch[0]['ts'])
+            # window alignment survives sharded staging: offset-1 rows are
+            # the offset-0 rows' successors, value columns match their ts
+            np.testing.assert_array_equal(np.asarray(batch[1]['ts']), ts0 + 1)
+            np.testing.assert_array_equal(
+                np.asarray(batch[0]['value']),
+                np.repeat(ts0[:, None], 3, axis=1).astype(np.float32))
+            seen_windows += 4
+        # 4 groups x 9 windows = 36 windows; drop_last trims to 36
+        assert seen_windows == 36
 
 
 def test_prefetch_stages_ngram_batches(seq_dataset):
