@@ -202,27 +202,20 @@ class IndexedDatasetReader:
                 out[name][mask] = col[idx]
         return out
 
-    def evaluate_predicate(self, predicate) -> np.ndarray:
-        """Global indices of the rows ``predicate`` includes, in dataset order.
+    def scan_columns(self, fields):
+        """Yield ``(piece_index, {field: decoded column}, n_rows)`` for every
+        piece, decoding ONLY ``fields`` (names from the full schema;
+        partition-derived columns synthesized) — the one-pass scan behind
+        predicate evaluation and the NGram window index build.
 
-        Runs ONCE (decoding only the predicate's fields, bypassing the
-        row-group cache) so the surviving row set is fixed up front — the
-        indexed loader's deterministic batch grid needs a known row universe,
-        unlike the streaming readers' per-row-group pushdown
-        (``readers/columnar_worker.py:_load_with_predicate``). Validated
-        against the FULL stored schema: predicates may use fields outside the
-        ``schema_fields`` view, like the streaming readers allow."""
+        The scan opens its own short-lived handles (closed on exit, even on
+        error) rather than registering into the reader's shared handle list:
+        the dataset object may be shared with live loaders whose in-flight
+        reads a close() would corrupt."""
         import pyarrow.parquet as pq
 
-        from petastorm_tpu.readers.columnar_worker import (
-            make_partition_columns, predicate_row_mask,
-            validate_predicate_fields)
-        fields = validate_predicate_fields(predicate, self.full_schema)
-        surviving = []
-        # the scan opens its own short-lived handles (closed on exit, even on
-        # error) rather than registering into the reader's shared handle list:
-        # the dataset object may be shared with live loaders whose in-flight
-        # reads a close() would corrupt
+        from petastorm_tpu.readers.columnar_worker import make_partition_columns
+        fields = sorted(set(fields))
         scan_files: Dict[str, tuple] = {}
         try:
             for piece_index, piece in enumerate(self.pieces):
@@ -248,15 +241,32 @@ class IndexedDatasetReader:
                             table.column(name), self.full_schema.fields[name])
                 cols.update(make_partition_columns(self.full_schema, piece, n,
                                                    set(fields)))
-                mask = predicate_row_mask(predicate, fields, cols, n)
-                surviving.append(self.row_offsets[piece_index]
-                                 + np.nonzero(mask)[0])
+                yield piece_index, cols, n
         finally:
             for _, handle in scan_files.values():
                 try:
                     handle.close()
                 except OSError:
                     pass
+
+    def evaluate_predicate(self, predicate) -> np.ndarray:
+        """Global indices of the rows ``predicate`` includes, in dataset order.
+
+        Runs ONCE (decoding only the predicate's fields, bypassing the
+        row-group cache) so the surviving row set is fixed up front — the
+        indexed loader's deterministic batch grid needs a known row universe,
+        unlike the streaming readers' per-row-group pushdown
+        (``readers/columnar_worker.py:_load_with_predicate``). Validated
+        against the FULL stored schema: predicates may use fields outside the
+        ``schema_fields`` view, like the streaming readers allow."""
+        from petastorm_tpu.readers.columnar_worker import (
+            predicate_row_mask, validate_predicate_fields)
+        fields = validate_predicate_fields(predicate, self.full_schema)
+        surviving = []
+        for piece_index, cols, n in self.scan_columns(fields):
+            mask = predicate_row_mask(predicate, fields, cols, n)
+            surviving.append(self.row_offsets[piece_index]
+                             + np.nonzero(mask)[0])
         if not surviving:
             return np.empty(0, np.int64)
         return np.concatenate(surviving).astype(np.int64)

@@ -33,48 +33,35 @@ import numpy as np
 from petastorm_tpu.errors import NoDataAvailableError
 from petastorm_tpu.indexed import IndexedBatchLoader, IndexedDatasetReader
 from petastorm_tpu.ngram import NGram, valid_window_starts
-from petastorm_tpu.readers.columnar_worker import _column_to_numpy
+from petastorm_tpu.transform import apply_columnar_transform, transform_schema
 
 logger = logging.getLogger(__name__)
 
 
-def _scan_timestamps(dataset: IndexedDatasetReader, ts_name: str) -> List[np.ndarray]:
-    """The timestamp column of every piece (and nothing else), via
-    short-lived file handles (same isolation rationale as
-    ``IndexedDatasetReader.evaluate_predicate``)."""
-    import pyarrow.parquet as pq
+def _scan_timestamps(dataset: IndexedDatasetReader, ts_name: str,
+                     predicate=None) -> List[tuple]:
+    """Per piece: ``(timestamp column, survivor local row indices or None)``,
+    one pass through :meth:`IndexedDatasetReader.scan_columns`.
 
-    from petastorm_tpu.utils import cast_partition_value
+    With a ``predicate``, its fields are read alongside the timestamps and
+    rows it rejects are dropped BEFORE window formation — the streaming NGram
+    semantics (``row_worker._load_rows_with_predicate`` filters rows, then
+    ``form_ngram_dicts`` scans the survivors), so filtering can create
+    timestamp gaps that ``delta_threshold`` then rejects."""
+    from petastorm_tpu.readers.columnar_worker import (
+        predicate_row_mask, validate_predicate_fields)
 
-    field = dataset.full_schema.fields.get(ts_name)
-    out: List[np.ndarray] = []
-    scan_files: Dict[str, tuple] = {}
-    try:
-        for piece in dataset.pieces:
-            if ts_name in piece.partition_dict:
-                value = cast_partition_value(
-                    field.numpy_dtype if field is not None else None,
-                    piece.partition_dict[ts_name])
-                out.append(np.full(piece.num_rows, value))
-                continue
-            entry = scan_files.get(piece.path)
-            if entry is None:
-                handle = dataset._filesystem.open(piece.path, 'rb')
-                try:
-                    entry = (pq.ParquetFile(handle), handle)
-                except Exception:
-                    handle.close()
-                    raise
-                scan_files[piece.path] = entry
-            table = entry[0].read_row_group(piece.row_group,
-                                            columns=[ts_name])
-            out.append(_column_to_numpy(table.column(ts_name), field))
-    finally:
-        for _, handle in scan_files.values():
-            try:
-                handle.close()
-            except OSError:
-                pass
+    pred_fields = (validate_predicate_fields(predicate, dataset.full_schema)
+                   if predicate is not None else [])
+    out: List[tuple] = []
+    for _, cols, n in dataset.scan_columns({ts_name} | set(pred_fields)):
+        ts = cols[ts_name]
+        if predicate is None:
+            out.append((ts, None))
+        else:
+            mask = predicate_row_mask(predicate, pred_fields, cols, n)
+            idx = np.nonzero(mask)[0].astype(np.int64)
+            out.append((ts[idx], idx))
     return out
 
 
@@ -94,17 +81,19 @@ class IndexedNGramLoader(IndexedBatchLoader):
 
     def __init__(self, dataset: IndexedDatasetReader, ngram: NGram,
                  batch_size: int, **kwargs):
-        for unsupported in ('predicate', 'transform_spec'):
-            if kwargs.get(unsupported) is not None:
-                raise ValueError('IndexedNGramLoader does not support {} '
-                                 '(use the streaming NGram reader)'
-                                 .format(unsupported))
         if kwargs.get('pad_spec') is not None:
             # no NGram path supports pad_spec anywhere (window fields are
             # fixed-shape per timestep) — don't suggest a fallback
             raise ValueError('IndexedNGramLoader does not support pad_spec '
                              '(NGram window fields are fixed-shape per '
                              'timestep)')
+        # predicate/transform run at WINDOW addressing / assembly here, not
+        # through the row superclass (whose row-level selection would fight
+        # the window permutation): the predicate fixes the surviving ROW set
+        # during the index scan (streaming semantics — windows form over
+        # survivors), the columnar transform runs per assembled batch.
+        predicate = kwargs.pop('predicate', None)
+        self._window_transform = kwargs.pop('transform_spec', None)
         ngram.resolve_regex_field_names(dataset.full_schema)
         self._ngram = ngram
         # Read only the NGram's field universe: without this, read_piece
@@ -116,8 +105,20 @@ class IndexedNGramLoader(IndexedBatchLoader):
         used = [n for n in ngram.get_all_field_names()
                 if n in dataset.full_schema.fields]
         self._read_fields = tuple(used)
+        view = dataset.full_schema.create_schema_view(
+            [dataset.full_schema.fields[n] for n in used])
+        if self._window_transform is not None:
+            # timestep views filter on POST-transform names; the transform
+            # itself receives the full read universe per gathered batch. The
+            # window universe is fixed at index build, so the transform must
+            # not alter the timestamp ordering (it runs after addressing).
+            self._transformed_schema = transform_schema(
+                view, self._window_transform)
+        else:
+            self._transformed_schema = view
+        visible = set(self._transformed_schema.fields)
         self._offsets, self._base_offset, self._fields_at = \
-            ngram.timestep_layout(set(used))
+            ngram.timestep_layout(visible)
         # fused-gather slices are views into the (n_offsets*B, ...) base
         # array; a field exposed at every offset covers its base entirely,
         # but a field exposed at FEW offsets (an image at offset 0 of a long
@@ -131,18 +132,23 @@ class IndexedNGramLoader(IndexedBatchLoader):
                              if c < len(self._offsets)}
         span = ngram.length
 
-        ts_per_piece = _scan_timestamps(dataset, ngram.timestamp_field_name)
+        scan = _scan_timestamps(dataset, ngram.timestamp_field_name,
+                                predicate=predicate)
         win_starts: List[np.ndarray] = []
         counts = []
         # sorted-position -> global row, flattened over pieces: entry
         # row_offsets[p] + s is the global row index of the s-th
-        # timestamp-sorted row of piece p. One vectorized lookup replaces the
-        # per-window Python loops of the round-4 assembler.
+        # timestamp-sorted SURVIVING row of piece p (all rows survive without
+        # a predicate). One vectorized lookup replaces the per-window Python
+        # loops of the round-4 assembler.
         pos_to_row = np.empty(dataset.total_rows, np.int64)
-        for p, ts in enumerate(ts_per_piece):
+        for p, (ts, survivors) in enumerate(scan):
             order = np.argsort(ts, kind='stable')
             lo = dataset.row_offsets[p]
-            pos_to_row[lo:lo + len(ts)] = lo + order
+            if survivors is None:
+                pos_to_row[lo:lo + len(ts)] = lo + order
+            else:
+                pos_to_row[lo:lo + len(ts)] = lo + survivors[order]
             starts = valid_window_starts(ts[order], span,
                                          ngram.delta_threshold,
                                          ngram.timestamp_overlap)
@@ -159,6 +165,12 @@ class IndexedNGramLoader(IndexedBatchLoader):
                              else np.empty(0, np.int64))
 
         super().__init__(dataset, batch_size, **kwargs)
+        # public attrs must report the ACTIVE config (super saw neither
+        # kwarg): the window loader owns predicate/transform handling, and
+        # .schema is the post-transform view of the NGram's read universe
+        self.predicate = predicate
+        self.transform_spec = self._window_transform
+        self.schema = self._transformed_schema
         # re-point the deterministic addressing at the WINDOW universe: the
         # permutation shuffles windows (grouped by piece), not rows
         self.total_rows = int(win_offsets[-1])       # total windows
@@ -186,6 +198,12 @@ class IndexedNGramLoader(IndexedBatchLoader):
         rel = np.asarray(self._offsets, np.int64) - self._base_offset
         rows = self._pos_to_row[(base_pos[None, :] + rel[:, None]).ravel()]
         cols = self._dataset.gather(rows, self._read_fields)
+        if self._window_transform is not None:
+            # one columnar transform over the whole (n_offsets*B) gather —
+            # row-wise by contract, so transforming the stacked offsets once
+            # equals the streaming path's per-row transform-then-window order
+            cols = apply_columnar_transform(self._window_transform,
+                                            self._transformed_schema, cols)
         n = len(win_ids)
         out: Dict[int, Dict[str, np.ndarray]] = {}
         for i, offset in enumerate(self._offsets):
@@ -240,10 +258,19 @@ def make_indexed_ngram_loader(dataset_url, ngram: NGram, batch_size: int,
                               prefetch_batches: int = 8,
                               storage_options=None,
                               cache_groups=None, mesh=None,
-                              batch_axis: str = 'data') -> IndexedNGramLoader:
+                              batch_axis: str = 'data',
+                              predicate=None,
+                              transform_spec=None) -> IndexedNGramLoader:
     """Factory: deterministic, O(1)-resumable NGram window batches — host
     numpy batches, or global ``jax.Array`` batches over ``mesh``
     (``batch_size`` is then the global window batch).
+
+    ``predicate`` drops rows BEFORE window formation during the index scan
+    (windows form over the survivors, exactly like the streaming NGram
+    reader's worker pushdown); ``transform_spec`` applies the columnar
+    transform contract per assembled batch (it must not alter the timestamp
+    field — the window universe is fixed at index build). Both preserve the
+    pure-function-of-cursor resume guarantee.
 
     ::
 
@@ -260,7 +287,8 @@ def make_indexed_ngram_loader(dataset_url, ngram: NGram, batch_size: int,
     kwargs = dict(num_epochs=num_epochs, seed=seed, shuffle=shuffle,
                   shuffle_window_groups=shuffle_window_groups,
                   workers_count=workers_count,
-                  prefetch_batches=prefetch_batches)
+                  prefetch_batches=prefetch_batches,
+                  predicate=predicate, transform_spec=transform_spec)
     if mesh is None:
         return IndexedNGramLoader(dataset, ngram, batch_size, **kwargs)
     return ShardedIndexedNGramLoader(dataset, ngram, batch_size, mesh=mesh,
