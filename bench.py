@@ -156,6 +156,16 @@ def main():
                 imagenet_jpeg_url, rows=imagenet_rows, image_codec='jpeg',
                 row_group_size_mb=1.0))
     scale_hints = {'image': {'scale': 2}}
+    # The decoded-columns disk-cache line uses its own store with BIG row
+    # groups: the row group is the cache-replay unit, and 1MB-encoded groups
+    # (~4 rows) make epochs 2+ pay per-chunk pool overhead ~8x more often
+    # than 8MB groups. (Tiny groups remain right for decode-bound epoch 1
+    # parallelism — that is the other stores' protocol.)
+    imagenet_rg8_path = '{}_{}_rg8'.format(IMAGENET_PATH, imagenet_rows)
+    imagenet_rg8_url = 'file://' + imagenet_rg8_path
+    _ensure(imagenet_rg8_path, '_common_metadata',
+            lambda: northstar.generate_imagenet_dataset(
+                imagenet_rg8_url, rows=imagenet_rows, row_group_size_mb=8.0))
 
     if on_tpu:
         mnist = northstar.run_mnist_train_bench(
@@ -189,6 +199,9 @@ def main():
         imagenet_jpeg = northstar.run_imagenet_train_bench(
             imagenet_jpeg_url, batch_size=32, num_steps=200, warmup_steps=12,
             image_size=128, decode_hints=scale_hints)
+        imagenet_cached = northstar.run_imagenet_cached_train_bench(
+            imagenet_rg8_url, rows=imagenet_rows, batch_size=32,
+            num_steps=120, image_size=128)
     else:
         mnist = northstar.run_mnist_train_bench(
             mnist_url, batch_size=mnist_batch, num_steps=15, hidden=256)
@@ -213,6 +226,9 @@ def main():
         imagenet_jpeg = northstar.run_imagenet_train_bench(
             imagenet_jpeg_url, batch_size=8, num_steps=4, image_size=96,
             decode_hints=scale_hints)
+        imagenet_cached = northstar.run_imagenet_cached_train_bench(
+            imagenet_rg8_url, rows=imagenet_rows, batch_size=8,
+            num_steps=8, image_size=96)
     columnar = northstar.run_columnar_read_bench(mnist_url)
 
     # Internal consistency: decode-only throughput must upper-bound
@@ -234,6 +250,24 @@ def main():
         'jpeg_hinted': _consistency(img_decode_jpeg, imagenet_jpeg),
     }
 
+    # The cached line's own context rides in the artifact: the claim is the
+    # throughput multiple over the decode-bound line (decode+resize skipped
+    # on epochs 2+), NOT the overlap figure — on a 1-core host the remaining
+    # per-byte work (cache read, collate, H2D staging, all GIL-shared with
+    # step dispatch) bounds overlap far below the >=90% target that the
+    # zero-host-work device cache reaches (mnist_train_cached). Measured
+    # r05: one-dispatch transfer protocols can print ~99% overlap here only
+    # by collapsing throughput ~10x (transfer riding inside "compute"), so
+    # this line keeps the throughput-optimal protocol and reports honestly.
+    cached_dict = imagenet_cached.as_dict()
+    if imagenet.samples_per_sec:
+        cached_dict['vs_decode_bound'] = round(
+            imagenet_cached.samples_per_sec / imagenet.samples_per_sec, 1)
+    cached_dict['note'] = ('claim = samples/sec multiple over imagenet_train '
+                          '(cache skips decode+resize); overlap on this '
+                          '1-core host is bounded by per-byte host work, '
+                          'see docs/benchmarks.md')
+
     print(json.dumps({
         'metric': 'hello_world_reader_throughput',
         'value': round(best, 2),
@@ -251,6 +285,7 @@ def main():
             'imagenet_train': imagenet.as_dict(),
             'image_decode_jpeg_hinted': img_decode_jpeg,
             'imagenet_train_jpeg_hinted': imagenet_jpeg.as_dict(),
+            'imagenet_train_cached': cached_dict,
             'columnar_read': columnar,
             'decode_train_consistency': consistency,
         },
