@@ -23,6 +23,12 @@ class InfeedReport:
     total_time_s: float
     stall_time_s: float
     compute_time_s: float
+    #: companion overlap measured with ``dispatch_ahead=0`` (block every
+    #: step) on the same warm pipeline — set by :func:`attach_sync_probe`.
+    #: Round 4 switched the LM benches to ``dispatch_ahead=2``, which made
+    #: the r03<->r04 overlap series cross-protocol; carrying BOTH figures
+    #: keeps the series interpretable without reading protocol history.
+    overlap_pct_sync: Optional[float] = None
 
     @property
     def overlap(self) -> float:
@@ -38,10 +44,31 @@ class InfeedReport:
         return self.samples / self.total_time_s if self.total_time_s else 0.0
 
     def as_dict(self):
-        return {'steps': self.steps, 'samples': self.samples,
-                'samples_per_sec': round(self.samples_per_sec, 2),
-                'infeed_stall_pct': round(100.0 * self.stall_fraction, 2),
-                'overlap_pct': round(100.0 * self.overlap, 2)}
+        out = {'steps': self.steps, 'samples': self.samples,
+               'samples_per_sec': round(self.samples_per_sec, 2),
+               'infeed_stall_pct': round(100.0 * self.stall_fraction, 2),
+               'overlap_pct': round(100.0 * self.overlap, 2)}
+        if self.overlap_pct_sync is not None:
+            out['overlap_pct_sync'] = round(self.overlap_pct_sync, 2)
+        return out
+
+
+#: default length of the dispatch_ahead=0 probe window; bench runners that
+#: pre-budget a finite loader's epochs must reserve this many extra steps
+SYNC_PROBE_STEPS = 20
+
+
+def attach_sync_probe(report: 'InfeedReport', batch_iterator, step_fn,
+                      num_steps: int = SYNC_PROBE_STEPS,
+                      count_fn: Optional[Callable] = None) -> 'InfeedReport':
+    """Measure a short ``dispatch_ahead=0`` window on the (already warm)
+    pipeline and attach its overlap to ``report`` as ``overlap_pct_sync`` —
+    the blocking-protocol companion figure (see ``InfeedReport``)."""
+    probe = measure_infeed_overlap(batch_iterator, step_fn,
+                                   num_steps=num_steps, warmup_steps=0,
+                                   count_fn=count_fn, dispatch_ahead=0)
+    report.overlap_pct_sync = 100.0 * probe.overlap
+    return report
 
 
 def measure_infeed_overlap(batch_iterator: Iterable, step_fn: Callable,

@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from petastorm_tpu.benchmark.infeed import InfeedReport, measure_infeed_overlap
+from petastorm_tpu.benchmark.infeed import (InfeedReport, attach_sync_probe,
+                                            measure_infeed_overlap)
 from petastorm_tpu.codecs import ArrowListCodec, CompressedImageCodec, ScalarCodec
 from petastorm_tpu.etl.dataset_metadata import materialize_dataset
 from petastorm_tpu.unischema import Unischema, UnischemaField
@@ -373,10 +374,11 @@ def run_transformer_train_bench(dataset_url: str, batch_size: int = 64,
                               num_epochs=None) as reader:
         loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
         batches = prefetch_batches(iter(loader), size=prefetch)
-        return measure_infeed_overlap(
+        report = measure_infeed_overlap(
             batches, step_fn, num_steps=num_steps, warmup_steps=warmup_steps,
             count_fn=lambda b: int(b['tokens'].shape[0]),
             dispatch_ahead=dispatch_ahead)
+        return attach_sync_probe(report, batches, step_fn)
 
 
 def generate_timeseries_token_dataset(output_url: str, rows: int = 4096,
@@ -475,10 +477,12 @@ def run_ngram_transformer_train_bench(dataset_url: str, window: int = 4,
                      num_epochs=None) as reader:
         loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
         batches = prefetch_batches(iter(loader), size=prefetch)
-        return measure_infeed_overlap(
+        report = measure_infeed_overlap(
             batches, step_fn, num_steps=num_steps, warmup_steps=warmup_steps,
             count_fn=lambda b: int(b[0]['tokens'].shape[0]),
             dispatch_ahead=dispatch_ahead)
+        return attach_sync_probe(report, batches, step_fn,
+                                 count_fn=lambda b: int(b[0]['tokens'].shape[0]))
 
 
 def run_indexed_ngram_transformer_train_bench(
@@ -511,15 +515,21 @@ def run_indexed_ngram_transformer_train_bench(
         workers_count=workers_count or _default_workers(),
         prefetch_batches=prefetch)
     # one index build: bump the epoch budget on the already-built loader
-    # (num_epochs is only consulted when iteration starts)
+    # (num_epochs is only consulted when iteration starts); the reserve
+    # covers the sync-protocol probe window
+    from petastorm_tpu.benchmark.infeed import SYNC_PROBE_STEPS
     loader.num_epochs = max(1, math.ceil(
-        (num_steps + warmup_steps + 2) / loader.batches_per_epoch))
+        (num_steps + warmup_steps + SYNC_PROBE_STEPS + 2)
+        / loader.batches_per_epoch))
     try:
-        return measure_infeed_overlap(
-            iter(loader), step_fn, num_steps=num_steps,
+        batches = iter(loader)
+        report = measure_infeed_overlap(
+            batches, step_fn, num_steps=num_steps,
             warmup_steps=warmup_steps,
             count_fn=lambda b: int(b[0]['tokens'].shape[0]),
             dispatch_ahead=dispatch_ahead)
+        return attach_sync_probe(report, batches, step_fn,
+                                 count_fn=lambda b: int(b[0]['tokens'].shape[0]))
     finally:
         loader.close()
 

@@ -56,9 +56,17 @@ def run(output_path: str = 'BENCH_scaling.json',
         'num_steps': num_steps,
         'rows': rows,
         'curve': curve,
-        'note': ('decode is CPU-bound: scaling with workers requires '
-                 'host_cpu_count cores to back them; on a 1-core host the '
-                 'curve is flat by construction'),
+        'note': ('read the two columns separately: SAMPLES/SEC is flat on a '
+                 '1-core host (decode is CPU-bound; workers time-slice the '
+                 'core, so no real decode scaling is possible), while '
+                 'OVERLAP% can still RISE with workers — more workers '
+                 'deepen effective read-ahead, so per-step stalls are '
+                 'partially absorbed by buffered batches and re-attributed '
+                 'from stall to compute. Rising overlap at flat throughput '
+                 'is queueing/attribution, NOT decode scaling. True scaling '
+                 'needs host_cpu_count real cores to back the pool — '
+                 'unverifiable in this 1-core environment (predicted, not '
+                 'measured; see docs/profile_mnist_decode.md).'),
     }
     with open(output_path, 'w') as f:
         json.dump(result, f, indent=2)

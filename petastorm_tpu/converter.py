@@ -101,10 +101,16 @@ def _to_arrow_table(data) -> pa.Table:
             return pa.Table.from_pandas(data, preserve_index=False)
     except ImportError:
         pass
-    # Spark DataFrame (optional interop; collected to the driver)
+    # Spark DataFrame (optional interop; collected to the driver). This is
+    # deliberately single-machine — the framework never runs Spark jobs
+    # (permanent decision, README "Migrating Spark pipelines"): the ceiling
+    # is driver RAM (~2x the decoded dataset during conversion). Above it,
+    # write parquet FROM Spark and read it with make_batch_reader directly.
     if hasattr(data, 'toPandas') and hasattr(data, 'schema'):
-        logger.info('Collecting Spark DataFrame to the driver for '
-                    'materialization')
+        logger.warning(
+            'Collecting the Spark DataFrame to this machine for '
+            'materialization (driver-RAM-bound; see README "Migrating '
+            'Spark pipelines" for the cluster-write pattern)')
         return pa.Table.from_pandas(data.toPandas(), preserve_index=False)
     raise TypeError('Unsupported input type {}; expected pyarrow.Table, '
                     'pandas.DataFrame or pyspark DataFrame'.format(type(data)))

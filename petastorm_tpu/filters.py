@@ -105,8 +105,13 @@ def _scalar_type_ok(dtype_kind: str, val) -> bool:
     if isinstance(val, (int, float)):
         return dtype_kind in 'biuf'
     if isinstance(val, str):
-        return dtype_kind in 'US'
-    return True                     # bytes/date/...: let the workers decide
+        # a str value against a bytes ('S') column would compare str-vs-bytes
+        # per row — always False, i.e. a silent zero-row result; surface the
+        # mismatch here like the other type checks (pass bytes instead)
+        return dtype_kind == 'U'
+    if isinstance(val, bytes):
+        return dtype_kind == 'S'
+    return True                     # date/decimal/...: let the workers decide
 
 
 def validate_filter_types(conjunctions: Sequence[Conjunction], schema,

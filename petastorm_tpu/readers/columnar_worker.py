@@ -196,24 +196,41 @@ def _code_digest(code) -> str:
     return '|'.join(parts)
 
 
+def _stable_value_digest(value) -> str:
+    """Value identity that does not truncate: ndarrays hash their full bytes
+    (``repr`` elides middle elements of large arrays, which would collide
+    distinct normalization tables); everything else uses repr."""
+    if isinstance(value, np.ndarray):
+        import hashlib
+        h = hashlib.md5(value.tobytes())
+        return 'ndarray:{}:{}:{}'.format(value.dtype, value.shape,
+                                         h.hexdigest())
+    return repr(value)
+
+
 def transform_fingerprint(spec) -> str:
     """Best-effort identity of a TransformSpec for cache keying: the func's
-    qualified name + code (bytecode, constants, defaults, closure values) +
-    declared schema edits. Catches logic, constant, default-arg, and
-    field-list edits; mutated closure OBJECTS whose repr doesn't change
-    remain invisible (caveat — pass a fresh ``cache_location`` when
-    parameterizing a transform through mutable closure state)."""
+    qualified name + code (bytecode, constants, positional AND keyword-only
+    defaults, closure values) + declared schema edits. Catches logic,
+    constant, default-arg, and field-list edits; mutated closure OBJECTS
+    whose repr doesn't change remain invisible (caveat — pass a fresh
+    ``cache_location`` when parameterizing a transform through mutable
+    closure state)."""
     import hashlib
     func = spec.func
     parts = []
     if func is not None:
         code = getattr(func, '__code__', None)
+        kwdefaults = getattr(func, '__kwdefaults__', None) or {}
         parts.extend([getattr(func, '__module__', ''),
                       getattr(func, '__qualname__', repr(func)),
                       _code_digest(code) if code is not None else '',
-                      repr(getattr(func, '__defaults__', None))])
+                      '|'.join(_stable_value_digest(v) for v in
+                               (getattr(func, '__defaults__', None) or ())),
+                      '|'.join('{}={}'.format(k, _stable_value_digest(v))
+                               for k, v in sorted(kwdefaults.items()))])
         closure = getattr(func, '__closure__', None) or ()
-        parts.extend(repr(getattr(cell, 'cell_contents', None))
+        parts.extend(_stable_value_digest(getattr(cell, 'cell_contents', None))
                      for cell in closure)
     parts.append(repr([(f.name, str(f.numpy_dtype), f.shape)
                        for f in (spec.edit_fields or [])]))

@@ -25,6 +25,37 @@ reassert_cpu_platform()
 import pytest  # noqa: E402
 
 
+# old-style hookwrapper (works on all pytest 7.x): this fallback exists
+# precisely for bare environments that may predate pluggy 1.2's wrapper=True
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    """SIGALRM fallback for ``@pytest.mark.timeout`` when pytest-timeout is
+    not installed (round-4 verdict: unenforced timeout marks made a hung
+    ``jax.distributed`` child able to hang the slow lane indefinitely). The
+    real plugin takes precedence when present; this fallback covers bare
+    environments on any SIGALRM-capable platform."""
+    import signal
+    marker = item.get_closest_marker('timeout')
+    if (marker is None or item.config.pluginmanager.hasplugin('timeout')
+            or not hasattr(signal, 'SIGALRM') or not marker.args):
+        yield
+        return
+    seconds = int(marker.args[0])
+
+    def on_alarm(signum, frame):
+        raise TimeoutError(
+            'test exceeded its @pytest.mark.timeout({}) guard '
+            '(conftest SIGALRM fallback)'.format(seconds))
+
+    previous = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, previous)
+
+
 def ref_attention(q, k, v, causal=True):
     """Dense-softmax attention reference shared by the kernel test modules."""
     import jax

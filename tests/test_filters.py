@@ -317,6 +317,31 @@ def test_type_mismatched_filter_value_raises_at_construction(tmp_path):
                           reader_pool_type='dummy')
 
 
+def test_str_filter_on_bytes_column_raises_at_construction(tmp_path):
+    """A str value against a bytes ('S') column compares str-vs-bytes per
+    row — always False, a silent zero-row result; it must fail fast instead
+    (advisor r04: filters.py str/bytes mismatch)."""
+    import numpy as np
+
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema('B', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('tag', np.bytes_, (), ScalarCodec(), False)])
+    url = 'file://' + str(tmp_path / 'bytes_store')
+    with materialize_dataset(url, schema) as w:
+        w.write_rows({'id': np.int64(i), 'tag': b'x%d' % i} for i in range(4))
+    with pytest.raises(ValueError, match='incompatible'):
+        make_batch_reader(url, filters=[('tag', '=', 'x1')],
+                          reader_pool_type='dummy')
+    # the matching bytes value works row-exactly
+    with make_batch_reader(url, filters=[('tag', '=', b'x1')],
+                           reader_pool_type='dummy') as r:
+        ids = [int(i) for batch in r for i in batch.id]
+    assert ids == [1]
+
+
 def test_filter_on_partition_column_outside_stored_schema(tmp_path):
     """Hive partition columns absent from the stored unischema are still
     filterable (the old _piece_passes_filters supported this)."""
