@@ -5,7 +5,8 @@
 - Primary metric: reader throughput on the hello-world schema with the same
   reader configuration as the reference's tool (3 thread workers, python
   read path — ``petastorm-throughput.py``), but measured READ-BOUND: a
-  10k-row store, 1k warmup + 10k measured samples, best of 5 runs with a
+  10k-row store, 1k warmup + 10k measured samples, MEDIAN of 5 runs (the
+  'statistic' field says so; rounds <=4 headlined the best run) with a
   recorded dispersion block. ``vs_baseline`` anchors against the
   reference's published tutorial figure (709.84 samples/sec on unspecified
   hardware, ``docs/benchmarks_tutorial.rst:20-21``) — a rough cross-tool

@@ -31,16 +31,20 @@ case "$LANE" in
     ;;
 esac
 
+echo '== bench-docs consistency gate =='
+python ci/check_bench_docs.py
+
 echo '== multi-chip dry run (8 virtual devices) =='
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python __graft_entry__.py 8 --dryrun-only
 
-# The type gate is a DECLARED guarantee: inside the docker image (which
-# pins mypy via dev-requirements.txt) a missing mypy is a broken image and
-# must FAIL, not skip. Outside the container (ad-hoc checkouts) the skip
-# stays, loudly. Override with STRICT_DEPS=1/0.
+# The type gate is a DECLARED guarantee: inside OUR docker image (which
+# pins mypy via dev-requirements.txt and sets PETASTORM_TPU_IMAGE=1) a
+# missing mypy is a broken image and must FAIL, not skip. In other
+# environments — including unrelated containers — the skip stays, loudly.
+# Override with STRICT_DEPS=1/0.
 if [ -z "${STRICT_DEPS:-}" ]; then
-    if [ -f /.dockerenv ]; then STRICT_DEPS=1; else STRICT_DEPS=0; fi
+    if [ "${PETASTORM_TPU_IMAGE:-}" = "1" ]; then STRICT_DEPS=1; else STRICT_DEPS=0; fi
 fi
 if python -c 'import mypy' 2>/dev/null; then
     echo '== mypy =='

@@ -53,9 +53,11 @@ class InfeedReport:
         return out
 
 
-#: default length of the dispatch_ahead=0 probe window; bench runners that
-#: pre-budget a finite loader's epochs must reserve this many extra steps
+#: default length of the dispatch_ahead=0 probe window and its warmup;
+#: bench runners that pre-budget a finite loader's epochs must reserve
+#: SYNC_PROBE_STEPS + SYNC_PROBE_WARMUP extra steps
 SYNC_PROBE_STEPS = 20
+SYNC_PROBE_WARMUP = 6
 
 
 def attach_sync_probe(report: 'InfeedReport', batch_iterator, step_fn,
@@ -63,9 +65,15 @@ def attach_sync_probe(report: 'InfeedReport', batch_iterator, step_fn,
                       count_fn: Optional[Callable] = None) -> 'InfeedReport':
     """Measure a short ``dispatch_ahead=0`` window on the (already warm)
     pipeline and attach its overlap to ``report`` as ``overlap_pct_sync`` —
-    the blocking-protocol companion figure (see ``InfeedReport``)."""
+    the blocking-protocol companion figure (see ``InfeedReport``).
+
+    The probe has its own short warmup: the main run's in-flight drain lets
+    prefetch buffers refill, and a probe that starts on a refilled buffer
+    would read several zero-stall steps and inflate the sync figure on
+    production-bound pipelines."""
     probe = measure_infeed_overlap(batch_iterator, step_fn,
-                                   num_steps=num_steps, warmup_steps=0,
+                                   num_steps=num_steps,
+                                   warmup_steps=SYNC_PROBE_WARMUP,
                                    count_fn=count_fn, dispatch_ahead=0)
     report.overlap_pct_sync = 100.0 * probe.overlap
     return report

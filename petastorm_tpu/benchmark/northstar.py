@@ -517,9 +517,10 @@ def run_indexed_ngram_transformer_train_bench(
     # one index build: bump the epoch budget on the already-built loader
     # (num_epochs is only consulted when iteration starts); the reserve
     # covers the sync-protocol probe window
-    from petastorm_tpu.benchmark.infeed import SYNC_PROBE_STEPS
+    from petastorm_tpu.benchmark.infeed import (SYNC_PROBE_STEPS,
+                                                SYNC_PROBE_WARMUP)
     loader.num_epochs = max(1, math.ceil(
-        (num_steps + warmup_steps + SYNC_PROBE_STEPS + 2)
+        (num_steps + warmup_steps + SYNC_PROBE_STEPS + SYNC_PROBE_WARMUP + 2)
         / loader.batches_per_epoch))
     try:
         batches = iter(loader)

@@ -199,12 +199,22 @@ def _code_digest(code) -> str:
 def _stable_value_digest(value) -> str:
     """Value identity that does not truncate: ndarrays hash their full bytes
     (``repr`` elides middle elements of large arrays, which would collide
-    distinct normalization tables); everything else uses repr."""
+    distinct normalization tables), recursing through list/tuple/dict
+    containers so a captured ``[lut_array]`` is covered too; everything else
+    uses repr."""
     if isinstance(value, np.ndarray):
         import hashlib
-        h = hashlib.md5(value.tobytes())
+        h = hashlib.md5(np.ascontiguousarray(value).tobytes())
         return 'ndarray:{}:{}:{}'.format(value.dtype, value.shape,
                                          h.hexdigest())
+    if isinstance(value, (list, tuple)):
+        return '{}[{}]'.format(type(value).__name__,
+                               ','.join(_stable_value_digest(v)
+                                        for v in value))
+    if isinstance(value, dict):
+        return 'dict{{{}}}'.format(','.join(
+            '{}:{}'.format(repr(k), _stable_value_digest(v))
+            for k, v in sorted(value.items(), key=lambda kv: repr(kv[0]))))
     return repr(value)
 
 

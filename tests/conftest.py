@@ -36,11 +36,17 @@ def pytest_runtest_call(item):
     environments on any SIGALRM-capable platform."""
     import signal
     marker = item.get_closest_marker('timeout')
-    if (marker is None or item.config.pluginmanager.hasplugin('timeout')
-            or not hasattr(signal, 'SIGALRM') or not marker.args):
+    limit = None
+    if marker is not None:
+        # positional @timeout(N) or keyword @timeout(seconds=N) — both are
+        # pytest-timeout's documented forms; missing either would recreate
+        # the silently-inert guard this hook exists to eliminate
+        limit = marker.args[0] if marker.args else marker.kwargs.get('seconds')
+    if (limit is None or item.config.pluginmanager.hasplugin('timeout')
+            or not hasattr(signal, 'SIGALRM')):
         yield
         return
-    seconds = int(marker.args[0])
+    seconds = int(limit)
 
     def on_alarm(signum, frame):
         raise TimeoutError(
