@@ -142,6 +142,13 @@ def _column_to_numpy(column: pa.ChunkedArray, field,
         return _list_column_to_numpy(column, field)
     if pa.types.is_string(column.type) or pa.types.is_large_string(column.type):
         return np.asarray(column.to_pylist(), dtype=object)
+    if column.null_count:
+        # preserve None cells (the per-row decode_row semantics): to_numpy
+        # would hole nullable ints into NaN floats, and a later astype to
+        # the declared int dtype would mint plausible-looking garbage
+        out = np.empty(len(column), dtype=object)
+        out[:] = column.to_pylist()
+        return out
     arr = column.to_numpy(zero_copy_only=False)
     if field.numpy_dtype is not None and not field.shape:
         try:

@@ -38,10 +38,10 @@ def pytest_runtest_call(item):
     marker = item.get_closest_marker('timeout')
     limit = None
     if marker is not None:
-        # positional @timeout(N) or keyword @timeout(seconds=N) — both are
+        # positional @timeout(N) or keyword @timeout(timeout=N) — both are
         # pytest-timeout's documented forms; missing either would recreate
         # the silently-inert guard this hook exists to eliminate
-        limit = marker.args[0] if marker.args else marker.kwargs.get('seconds')
+        limit = marker.args[0] if marker.args else marker.kwargs.get('timeout')
     if (limit is None or item.config.pluginmanager.hasplugin('timeout')
             or not hasattr(signal, 'SIGALRM')):
         yield

@@ -50,8 +50,13 @@ class TestTransformerLm:
                                                   sample, train)
         url = 'file://' + str(tmp_path / 'tokens')
         generate_token_stream(url, n_steps=256)
-        losses, params, config = train(url, steps=12)
-        assert losses[-1] < losses[0]
+        # 24 steps + first-vs-last WINDOW averages: a single-step comparison
+        # at 12 steps flipped sign with benign changes in window order (the
+        # r05 chunked NGram path yields windows forward instead of the old
+        # reversed pop) — the signal on random tokens is positional bias,
+        # which needs a few more steps to dominate step-to-step noise
+        losses, params, config = train(url, steps=24)
+        assert sum(losses[-4:]) / 4 < sum(losses[:4]) / 4
         out = sample(params, config, max_new_tokens=16)
         arr = np.asarray(out)
         assert arr.shape == (1, 16)
