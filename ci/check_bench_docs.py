@@ -22,7 +22,10 @@ absolute numbers at all; the docs express them qualitatively or as quoted
 historical ratios.
 
 Exit 0 when every annotation matches; prints each mismatch otherwise.
-Usage: python ci/check_bench_docs.py [docs/benchmarks.md ...]
+``--fix`` rewrites every annotated display from its artifact instead of
+checking (how the docs are regenerated after recording a new artifact —
+the prose stays hand-written, the numbers are derived).
+Usage: python ci/check_bench_docs.py [--fix] [docs/benchmarks.md ...]
 """
 
 import json
@@ -82,49 +85,68 @@ def _load(cache, filename):
     return cache[filename]
 
 
-def check_file(doc_path: str):
+def _derive(cache, spec_text: str) -> str:
+    spec = spec_text.split()
+    fmt = 'raw'
+    if spec and spec[-1].startswith('fmt='):
+        fmt = spec.pop()[4:]
+    if len(spec) == 2:
+        value = _lookup(_load(cache, spec[0]), spec[1])
+    elif len(spec) == 4:
+        value = (_lookup(_load(cache, spec[0]), spec[1])
+                 / _lookup(_load(cache, spec[2]), spec[3]))
+    else:
+        raise ValueError('annotation needs 1 or 2 (file, path) pairs, '
+                         'got {!r}'.format(spec))
+    return _format(float(value), fmt)
+
+
+def check_file(doc_path: str, fix: bool = False):
     with open(os.path.join(ROOT, doc_path)) as f:
         text = f.read()
     cache = {}
     errors = []
     count = 0
-    for match in ANNOTATION.finditer(text):
+
+    def handle(match):
+        nonlocal count
         count += 1
-        spec = match.group('spec').split()
         display = ' '.join(match.group('display').split())
         try:
-            fmt = 'raw'
-            if spec and spec[-1].startswith('fmt='):
-                fmt = spec.pop()[4:]
-            if len(spec) == 2:
-                value = _lookup(_load(cache, spec[0]), spec[1])
-            elif len(spec) == 4:
-                value = (_lookup(_load(cache, spec[0]), spec[1])
-                         / _lookup(_load(cache, spec[2]), spec[3]))
-            else:
-                raise ValueError('annotation needs 1 or 2 (file, path) '
-                                 'pairs, got {!r}'.format(spec))
-            expected = _format(float(value), fmt)
+            expected = _derive(cache, match.group('spec'))
         except Exception as e:  # noqa: BLE001 - report, don't crash the gate
             errors.append('{}: bad annotation {!r}: {}'.format(
-                doc_path, ' '.join(spec), e))
-            continue
+                doc_path, match.group('spec'), e))
+            return match.group(0)
         if display != expected:
+            if fix:
+                return '<!--bench {}-->{}<!--/bench-->'.format(
+                    match.group('spec'), expected)
             errors.append(
-                "{}: displayed {!r} but {} {} (fmt={}) derives {!r}".format(
-                    doc_path, display, spec[0], spec[1], fmt, expected))
+                "{}: displayed {!r} but {!r} derives {!r}".format(
+                    doc_path, display, match.group('spec'), expected))
+        return match.group(0)
+
+    new_text = ANNOTATION.sub(handle, text)
+    if fix and new_text != text:
+        with open(os.path.join(ROOT, doc_path), 'w') as f:
+            f.write(new_text)
     return count, errors
 
 
 def main(argv):
-    docs = argv[1:] or [os.path.join(*d.split('/')) for d in DEFAULT_DOCS]
+    args = list(argv[1:])
+    fix = '--fix' in args
+    if fix:
+        args.remove('--fix')
+    docs = args or [os.path.join(*d.split('/')) for d in DEFAULT_DOCS]
     total = 0
     all_errors = []
     for doc in docs:
-        count, errors = check_file(doc)
+        count, errors = check_file(doc, fix=fix)
         total += count
         all_errors.extend(errors)
-    if total < MIN_ANNOTATIONS and not argv[1:]:
+    if total < MIN_ANNOTATIONS and not args:
         all_errors.append(
             'only {} bench annotations found (expected >= {}): the gate '
             'must not be emptied out'.format(total, MIN_ANNOTATIONS))
@@ -132,8 +154,8 @@ def main(argv):
         for err in all_errors:
             print('BENCH-DOCS MISMATCH: {}'.format(err), file=sys.stderr)
         return 1
-    print('bench-docs gate: {} annotations verified against their '
-          'artifacts'.format(total))
+    print('bench-docs gate: {} annotations {} against their artifacts'.format(
+        total, 'rewritten' if fix else 'verified'))
     return 0
 
 

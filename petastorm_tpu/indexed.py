@@ -199,6 +199,18 @@ class IndexedDatasetReader:
                 if name not in out:
                     out[name] = np.empty((len(global_rows),) + col.shape[1:],
                                          dtype=col.dtype)
+                elif out[name].dtype != col.dtype:
+                    # pieces can decode the same field to different dtypes —
+                    # a nullable int column is int64 in null-free groups but
+                    # NaN-holed float in null-bearing ones; assigning into
+                    # the first piece's dtype would cast NaN to garbage ints
+                    if out[name].dtype.kind == 'O' or col.dtype.kind == 'O':
+                        promoted = np.dtype(object)
+                    else:
+                        promoted = np.promote_types(out[name].dtype,
+                                                    col.dtype)
+                    if promoted != out[name].dtype:
+                        out[name] = out[name].astype(promoted)
                 out[name][mask] = col[idx]
         return out
 

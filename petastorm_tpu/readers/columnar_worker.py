@@ -142,20 +142,19 @@ def _column_to_numpy(column: pa.ChunkedArray, field,
         return _list_column_to_numpy(column, field)
     if pa.types.is_string(column.type) or pa.types.is_large_string(column.type):
         return np.asarray(column.to_pylist(), dtype=object)
-    if column.null_count:
-        # preserve None cells (the per-row decode_row semantics): to_numpy
-        # would hole nullable ints into NaN floats, and a later astype to
-        # the declared int dtype would mint plausible-looking garbage
-        out = np.empty(len(column), dtype=object)
-        out[:] = column.to_pylist()
-        return out
     arr = column.to_numpy(zero_copy_only=False)
     if field.numpy_dtype is not None and not field.shape:
         try:
             target = np.dtype(field.numpy_dtype)
         except TypeError:
             return arr
-        if arr.dtype != target and arr.dtype.kind not in ('O', 'U', 'S'):
+        # null-bearing numeric columns stay NaN-holed floats (pandas/arrow
+        # parity — the documented batched-path semantics); an astype to a
+        # declared int dtype would mint garbage where the nulls were. The
+        # row reader re-decodes such columns per cell with None preserved
+        # (row_worker._load_columns).
+        if (arr.dtype != target and arr.dtype.kind not in ('O', 'U', 'S')
+                and not (column.null_count and target.kind in 'biu')):
             arr = arr.astype(target)
     return arr
 

@@ -127,12 +127,41 @@ class RowGroupWorker(ParquetPieceWorker):
 
     # -- columnar window path --------------------------------------------------
 
-    def _load_columns(self, piece, names):
+    def _load_columns(self, piece, names, preserve_scalar_nulls=False):
         """Read + columnar-decode ``names`` (partition columns synthesized) —
-        shared by the window-chunk path and the columnar row load."""
+        shared by the window-chunk path and the columnar row load.
+
+        ``preserve_scalar_nulls``: the ROW path's contract is decode_row's —
+        a null cell is ``None``, never a NaN-holed float that an astype to
+        the declared int dtype would turn into garbage. Null-bearing scalar
+        columns re-decode per cell with the field's own decode semantics
+        into object arrays. Scoped HERE (not in the shared
+        ``_column_to_numpy``): the columnar/indexed batch paths need a
+        STABLE numeric dtype per field across row groups (their assembly
+        pre-allocates from the first piece), and they keep the documented
+        NaN-holing arrow/pandas parity."""
         from petastorm_tpu.readers.columnar_worker import make_partition_columns
         table = self._read_columns(piece, self._stored_columns(names, piece))
         columns = self._decode_table(table, names)
+        if preserve_scalar_nulls:
+            for name in names:
+                if name not in table.column_names or name not in columns:
+                    continue
+                column = table.column(name)
+                if not column.null_count or columns[name].dtype == object:
+                    continue   # object columns already carry None cells
+                field = self._full_schema.fields[name]
+                decode = self._decode_overrides.get(name)
+                if decode is None and field.codec is not None:
+                    decode = (lambda v, _f=field: _f.codec.decode(_f, v))
+                elif decode is None and isinstance(field.numpy_dtype, np.dtype) \
+                        and field.numpy_dtype.kind in 'biufc':
+                    decode = field.numpy_dtype.type
+                out = np.empty(len(column), dtype=object)
+                out[:] = [None if v is None
+                          else (decode(v) if decode is not None else v)
+                          for v in column.to_pylist()]
+                columns[name] = out
         columns.update(make_partition_columns(self._full_schema, piece,
                                               table.num_rows, set(names)))
         return columns
@@ -193,7 +222,7 @@ class RowGroupWorker(ParquetPieceWorker):
         # and then splits into row dicts — ~2x less non-codec overhead per
         # row than to_pylist + per-row decode_row on decode-bound stores.
         names = list(self._schema.fields.keys())
-        columns = self._load_columns(piece, names)
+        columns = self._load_columns(piece, names, preserve_scalar_nulls=True)
         keys = [n for n in names if n in columns]
         cols = [columns[k] for k in keys]
         return [dict(zip(keys, values)) for values in zip(*cols)]

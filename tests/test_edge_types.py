@@ -136,6 +136,9 @@ def test_nullable_scalar_cells_stay_none_in_row_reader(tmp_path):
         rows = {int(row.id): row for row in r}
     assert rows[1].maybe_int is None and rows[3].maybe_int is None
     assert int(rows[0].maybe_int) == 7 and int(rows[2].maybe_int) == 9
+    # non-null cells keep the declared numpy type even in null-bearing
+    # groups (decode_row's cast semantics, not plain to_pylist ints)
+    assert isinstance(rows[0].maybe_int, np.int64)
     assert rows[1].maybe_float is None
     assert float(rows[3].maybe_float) == 3.5
 

@@ -506,3 +506,30 @@ class TestRaggedFieldsExactResume:
         assert batch['seq'].shape == (16, 8)
         assert batch['seq_len'].shape == (16,)
         loader.close()
+
+
+def test_gather_promotes_dtype_across_mixed_null_pieces(tmp_path):
+    """A nullable int column decodes int64 in null-free groups but NaN-holed
+    float in null-bearing ones; gather must promote the output dtype instead
+    of casting NaN into garbage ints (r05 review finding)."""
+    import numpy as np
+
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+    from petastorm_tpu.indexed import make_indexed_loader
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('N', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('m', np.int64, (), ScalarCodec(), True)])
+    url = 'file://' + str(tmp_path / 'mixed_nulls')
+    vals = list(range(10)) + [None if i % 2 else i for i in range(10, 20)]
+    with materialize_dataset(url, schema, rows_per_file=10) as w:
+        w.write_rows({'id': np.int64(i), 'm': vals[i]} for i in range(20))
+    with make_indexed_loader(url, batch_size=20, num_epochs=1,
+                             shuffle=False) as loader:
+        batch = next(iter(loader))
+    m = batch['m']
+    assert m.dtype.kind == 'f'
+    assert float(m[0]) == 0.0 and float(m[10]) == 10.0
+    assert np.isnan(m[11]) and np.isnan(m[19])

@@ -393,6 +393,46 @@ class TestShardedIndexedNGram:
             got += 1
         assert got == len(host_batches) > 0
 
+    def test_predicate_and_transform_match_host_loader(self, tmp_path):
+        """Predicate + columnar transform compose with mesh sharding: the
+        sharded stream equals the host loader's under identical config
+        (the sub-batch slice happens AFTER window addressing, so filtering
+        and transforming commute with sharding)."""
+        import jax
+
+        from petastorm_tpu.predicates import in_lambda
+        from petastorm_tpu.transform import TransformSpec
+        url = _write(tmp_path / 'sharded_pt', list(range(60)))
+        ngram = _ngram(2, delta_threshold=5)
+        mesh = self._mesh()
+        kwargs = dict(batch_size=8, num_epochs=1, seed=2, workers_count=2,
+                      predicate=in_lambda(['label'],
+                                          lambda v: v['label'] != 4),
+                      transform_spec=TransformSpec(
+                          lambda d: dict(d, value=d['value'] * 10)))
+        host_batches = list(make_indexed_ngram_loader(url, ngram, **kwargs))
+        assert host_batches
+        sharded = make_indexed_ngram_loader(url, ngram, mesh=mesh, **kwargs)
+        got = 0
+        for hb, sb in zip(host_batches, sharded):
+            for off in hb:
+                for field in hb[off]:
+                    arr = sb[off][field]
+                    assert isinstance(arr, jax.Array)
+                    np.testing.assert_array_equal(np.asarray(arr),
+                                                  hb[off][field])
+            # predicate really applied (label 4 absent)...
+            ts0 = np.asarray(sb[0]['ts'])
+            assert 4 not in {int(x) % 7 for x in ts0}
+            # ...and the transform really ran (value = ts * 10, not ts):
+            # without this, a loader that silently dropped transform_spec in
+            # BOTH modes would still pass the host-vs-sharded comparison
+            np.testing.assert_array_equal(
+                np.asarray(sb[0]['value']),
+                np.repeat(ts0[:, None] * 10, 3, axis=1).astype(np.float32))
+            got += 1
+        assert got == len(host_batches)
+
     def test_resume_matches_host_loader(self, tmp_path):
         url = _write(tmp_path / 'sharded_resume', list(range(60)))
         ngram = _ngram(2)
