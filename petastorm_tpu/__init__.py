@@ -12,6 +12,7 @@ from petastorm_tpu.transform import TransformSpec  # noqa: F401
 
 __all__ = ['make_reader', 'make_batch_reader', 'make_columnar_reader',
            'make_indexed_loader', 'make_indexed_ngram_loader',
+           'WeightedIndexedMixture',
            'TransformSpec', 'NoDataAvailableError',
            'make_jax_loader', 'make_dataset_converter', 'materialize_dataset',
            '__version__']
@@ -28,6 +29,9 @@ def __getattr__(name):
     if name == 'make_indexed_ngram_loader':
         from petastorm_tpu.indexed_ngram import make_indexed_ngram_loader
         return make_indexed_ngram_loader
+    if name == 'WeightedIndexedMixture':
+        from petastorm_tpu.indexed_mixture import WeightedIndexedMixture
+        return WeightedIndexedMixture
     if name == 'make_jax_loader':
         from petastorm_tpu.jax_utils import make_jax_loader
         return make_jax_loader

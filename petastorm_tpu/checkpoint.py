@@ -22,10 +22,12 @@ For **O(1) exact resume with any worker count** use
 :mod:`petastorm_tpu.indexed_ngram` (``make_indexed_ngram_loader``; windows
 addressed the same way). Their cursors restore instantly and byte-exactly —
 no replay. Ragged fields join in via ``make_indexed_loader(...,
-pad_spec=...)``, which pads them inside the deterministic batch function
-(``tests/test_indexed_loader.py::TestRaggedFieldsExactResume``). This module
-remains the replay fallback for the queue-based streaming readers (weighted
-mixes, worker-side predicates over streaming pools).
+pad_spec=...)``; predicates and TransformSpecs are supported on both (r05);
+weighted mixes via :class:`petastorm_tpu.indexed_mixture.WeightedIndexedMixture`
+(counter-keyed source draws, so the mixture cursor is O(1) too). This module
+remains the replay fallback only for queue-based STREAMING pipelines that
+cannot move to the indexed loaders (e.g. live worker-side predicate pushdown
+over a streaming pool, or infinite ``num_epochs=None`` streams).
 """
 
 from __future__ import annotations

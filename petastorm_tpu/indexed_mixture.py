@@ -1,0 +1,137 @@
+"""Deterministic weighted mixture of indexed loaders with O(1) exact resume.
+
+The streaming :class:`~petastorm_tpu.weighted_sampling_reader.WeightedSamplingReader`
+(reference ``petastorm/weighted_sampling_reader.py:90-95``) draws from live
+queue-backed readers, so a mid-stream checkpoint can only be approximated by
+replay (``checkpoint.py``'s documented fallback). This module closes that
+last replay-fallback frontier the same way the indexed loaders did for rows
+and NGram windows: make the ENTIRE mixed stream a pure function of
+``(sources, probabilities, seed, step)``.
+
+- the source chosen at step ``k`` is ``choice(seed, k)`` — a counter-keyed
+  draw, independent of consumption history;
+- each source is an :class:`~petastorm_tpu.indexed.IndexedBatchLoader`-family
+  loader whose own stream is already a pure function of its cursor;
+- therefore ``state_dict()`` is just ``{'step': k, 'sources': [sub-cursors]}``
+  and a restored mixture reproduces the remaining stream byte-for-byte,
+  with any worker counts.
+
+Iteration stops when the chosen source is exhausted (reference mixture
+semantics: the first exhausted pick ends the stream).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class WeightedIndexedMixture:
+    """Mix the batch streams of several indexed loaders by probability.
+
+    :param loaders: indexed-family loaders (row ``IndexedBatchLoader`` /
+        ``IndexedNGramLoader`` / their sharded variants). They need not
+        share a schema — the caller mixes what it can consume — but they
+        must all be batch-granular (each pick yields one batch).
+    :param probabilities: per-loader sampling weights (normalized).
+    :param seed: the mixture's OWN seed; the draw at step ``k`` depends only
+        on ``(seed, k)``, so the choice sequence survives checkpoint/resume
+        without recording it.
+    """
+
+    def __init__(self, loaders: Sequence, probabilities: Sequence[float],
+                 seed: int = 0):
+        from petastorm_tpu.weighted_sampling_reader import normalize_cumulative
+        if len(loaders) != len(probabilities):
+            raise ValueError('loaders and probabilities must have equal length')
+        if not loaders:
+            raise ValueError('At least one loader is required')
+        for loader in loaders:
+            # duck-typed indexed-family check: the O(1) cursor pair PLUS the
+            # iteration/lifecycle surface this class drives. (A replay-based
+            # checkpointable that happened to grow all four would still be
+            # wrong here — the byte-exact guarantee needs cursor-addressed
+            # streams — but it cannot be detected structurally; the docstring
+            # states the contract.)
+            missing = [attr for attr in ('state_dict', 'load_state_dict',
+                                         '__iter__', 'close')
+                       if not hasattr(loader, attr)]
+            if missing:
+                raise ValueError(
+                    'WeightedIndexedMixture needs indexed-family loaders '
+                    '(cursor state_dict/load_state_dict + __iter__/close); '
+                    '{!r} lacks {}. Use WeightedSamplingReader for '
+                    'streaming readers.'.format(type(loader).__name__,
+                                                missing))
+        self._loaders = list(loaders)
+        self._cumulative = normalize_cumulative(probabilities)
+        self.seed = seed
+        self.step = 0
+
+    # -- deterministic addressing ---------------------------------------------
+
+    def _choice(self, step: int) -> int:
+        """Source drawn at global step ``step`` — pure function of
+        (seed, step), NOT of any consumption history."""
+        from petastorm_tpu.weighted_sampling_reader import draw_index
+        return draw_index(self._cumulative,
+                          np.random.default_rng((self.seed, step)).random())
+
+    # -- checkpoint state ------------------------------------------------------
+
+    def state_dict(self) -> Dict:
+        """O(1): the mixture step plus each source's own O(1) cursor."""
+        return {'step': self.step,
+                'sources': [ld.state_dict() for ld in self._loaders],
+                'version': 1}
+
+    def load_state_dict(self, state: Dict) -> None:
+        if state.get('version', 1) != 1:
+            raise ValueError('Unknown state version {}'.format(
+                state.get('version')))
+        if len(state['sources']) != len(self._loaders):
+            raise ValueError('state has {} sources, mixture has {}'.format(
+                len(state['sources']), len(self._loaders)))
+        self.step = int(state['step'])
+        for loader, sub in zip(self._loaders, state['sources']):
+            loader.load_state_dict(sub)
+
+    # -- iteration -------------------------------------------------------------
+
+    def __iter__(self):
+        iterators: List[Optional[object]] = [None] * len(self._loaders)
+        try:
+            while True:
+                pick = self._choice(self.step)
+                if iterators[pick] is None:
+                    iterators[pick] = iter(self._loaders[pick])
+                batch = next(iterators[pick], None)
+                if batch is None:
+                    return          # chosen source exhausted: stream ends
+                self.step += 1
+                yield batch
+        finally:
+            first_error = None
+            for it in iterators:
+                if it is None:
+                    continue
+                try:
+                    it.close()
+                except Exception as e:  # noqa: BLE001 - close the REST first
+                    # one source's teardown failure must not leak the other
+                    # sources' worker pools and parquet fds
+                    if first_error is None:
+                        first_error = e
+            if first_error is not None:
+                raise first_error
+
+    def close(self):
+        for loader in self._loaders:
+            loader.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.close()

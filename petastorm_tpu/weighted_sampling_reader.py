@@ -12,6 +12,28 @@ from typing import List, Optional
 import numpy as np
 
 
+def normalize_cumulative(probabilities) -> np.ndarray:
+    """Validated, normalized cumulative probability edges — shared by the
+    streaming mixture and the indexed mixture so the draw semantics cannot
+    diverge."""
+    probabilities = list(probabilities)
+    if not probabilities:
+        raise ValueError('At least one probability is required')
+    if any(p < 0 for p in probabilities):
+        raise ValueError('probabilities must be non-negative, got {!r}'
+                         .format(probabilities))
+    total = float(sum(probabilities))
+    if total <= 0:
+        raise ValueError('probabilities must sum to a positive value')
+    return np.cumsum([p / total for p in probabilities])
+
+
+def draw_index(cumulative: np.ndarray, unit_sample: float) -> int:
+    """Map one uniform [0,1) draw onto the cumulative edges."""
+    idx = int(np.searchsorted(cumulative, unit_sample, side='right'))
+    return min(idx, len(cumulative) - 1)
+
+
 class WeightedSamplingReader:
     """On every ``next()``, picks reader ``i`` with probability ``probabilities[i]``.
 
@@ -25,11 +47,8 @@ class WeightedSamplingReader:
             raise ValueError('readers and probabilities must have equal length')
         if not readers:
             raise ValueError('At least one reader is required')
-        total = float(sum(probabilities))
-        if total <= 0:
-            raise ValueError('probabilities must sum to a positive value')
         self._readers = readers
-        self._cumulative = np.cumsum([p / total for p in probabilities])
+        self._cumulative = normalize_cumulative(probabilities)
         self._rng = np.random.default_rng(seed)
 
         first = readers[0]
@@ -50,8 +69,7 @@ class WeightedSamplingReader:
         return self
 
     def __next__(self):
-        choice = int(np.searchsorted(self._cumulative, self._rng.random(), side='right'))
-        choice = min(choice, len(self._readers) - 1)
+        choice = draw_index(self._cumulative, self._rng.random())
         try:
             return next(self._readers[choice])
         except StopIteration:

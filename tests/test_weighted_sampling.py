@@ -97,7 +97,10 @@ class TestValidation:
             WeightedSamplingReader([], [])
         with pytest.raises(ValueError, match='positive'):
             WeightedSamplingReader([_StubReader('a')], [0.0])
-        with pytest.raises(ValueError, match='positive'):
+        # negative weights fail fast on their own (r05: previously they were
+        # only caught when the TOTAL went non-positive, so [-1, 1] slipped
+        # into a nonsense cumulative)
+        with pytest.raises(ValueError, match='non-negative'):
             WeightedSamplingReader([_StubReader('a'), _StubReader('b')],
                                    [-1.0, 1.0])
 
