@@ -108,6 +108,13 @@ def main():
                      'pool': 'thread'},
     }
 
+    # -- transport: pickle vs zero-copy worker->loader path -----------------
+    # Quick mode keeps this to a few seconds; the copy counters and the
+    # in-process MB/s ratio are the stable signals (the pool-stream MB/s is
+    # spawn-dominated at this item count and is reported for context only).
+    from petastorm_tpu.benchmark.transport import run_transport_bench
+    transport = run_transport_bench(quick=True)
+
     # -- north-star: train-step infeed overlap ------------------------------
     # Accelerator-scale configs for any non-CPU backend; dataset paths carry
     # the size parameters so a platform change can't reuse a stale store.
@@ -281,6 +288,7 @@ def main():
         'unit': 'samples/sec',
         'vs_baseline': round(median / BASELINE_SAMPLES_PER_SEC, 3),
         'dispersion': dispersion,
+        'transport': transport,
         'northstar': {
             'platform': platform,
             'mnist_train': mnist.as_dict(),

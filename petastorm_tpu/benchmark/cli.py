@@ -35,6 +35,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help='Repeat the measurement N times and report '
                              'best/median/min + spread (noisy shared hosts '
                              'need dispersion, not one sample)')
+    parser.add_argument('-d', '--diagnostics', action='store_true',
+                        help='Print the per-stage pipeline telemetry '
+                             '(Reader.diagnostics) of the median run')
     parser.add_argument('-v', action='store_true', help='INFO logging')
     return parser
 
@@ -63,6 +66,11 @@ def main(argv=None) -> int:
               '{:.2f} samples/sec (spread {:.1f}%)'.format(
                   len(rates), rates[0], median, rates[-1],
                   100.0 * (rates[-1] - rates[0]) / median if median else 0.0))
+    if args.diagnostics and result.diagnostics is not None:
+        import json
+        print('Pipeline telemetry (median run): {}'.format(
+            json.dumps({k: (round(v, 4) if isinstance(v, float) else v)
+                        for k, v in sorted(result.diagnostics.items())})))
     return 0
 
 

@@ -22,6 +22,10 @@ class ThroughputResult:
     measure_cycles: int
     rss_mb: float
     cpu_percent: float
+    #: ``Reader.diagnostics`` snapshot taken right after the measured window:
+    #: per-stage wall times (worker io/decode, serialize/deserialize, queue
+    #: wait), payload bytes/copies, and queue-occupancy gauges.
+    diagnostics: Optional[dict] = None
 
 
 def _consume(iterator, count: int, batched: bool) -> int:
@@ -83,8 +87,10 @@ def reader_throughput(dataset_url: str,
         elapsed = time.perf_counter() - start
         cpu = proc.cpu_percent()
         rss = proc.memory_info().rss / (1024.0 * 1024.0)
+        diagnostics = reader.diagnostics
 
     return ThroughputResult(samples_per_sec=actual / elapsed,
                             warmup_cycles=warmup_cycles,
                             measure_cycles=actual,
-                            rss_mb=rss, cpu_percent=cpu)
+                            rss_mb=rss, cpu_percent=cpu,
+                            diagnostics=diagnostics)

@@ -24,6 +24,7 @@ transfer unless the caller handles them.
 import collections
 import logging
 import threading
+import time
 from decimal import Decimal
 
 import numpy as np
@@ -310,6 +311,10 @@ class JaxDataLoader(JaxLoaderBase):
                                       'JaxDataLoader')
         self._cache = [] if inmemory_cache_all else None
         self._cache_complete = False
+        #: The reader pool's ReaderStats (None for readers without one):
+        #: the loader gauges shuffle-buffer occupancy into it, and the
+        #: device-staging helpers time ``jax.device_put`` against it.
+        self.stats = getattr(reader, 'stats', None)
 
     def _cache_hot(self):
         return self._cache_complete
@@ -358,10 +363,13 @@ class JaxDataLoader(JaxLoaderBase):
         retrieved batch (the chunked NGram path unflattens its keys)."""
         post = post or (lambda b: b)
         buffer = self._make_batched_buffer()
+        stats = self.stats
         for columns in column_stream:
             while not buffer.can_add():
                 yield post(buffer.retrieve())
             buffer.add_many(columns)
+            if stats is not None:
+                stats.gauge('shuffle_buffer_depth', buffer.size)
             while buffer.can_retrieve() and buffer.size >= self.batch_size:
                 yield post(buffer.retrieve())
         buffer.finish()
@@ -450,6 +458,8 @@ class JaxDataLoader(JaxLoaderBase):
             if final and rows and not self.drop_last:
                 yield collate(rows)
 
+        stats = self.stats
+        row_count = 0
         for row in self.reader:
             row = prepare(row)
             while not buffer.can_add():
@@ -458,6 +468,11 @@ class JaxDataLoader(JaxLoaderBase):
                 if not buffer.can_retrieve():
                     break
             buffer.add_many([row])
+            # sample the gauge sparsely: a lock acquire per row would tax the
+            # very hot path this telemetry exists to diagnose
+            row_count += 1
+            if stats is not None and row_count % 64 == 1:
+                stats.gauge('shuffle_buffer_depth', buffer.size)
             for b in drain(False):
                 yield b
         buffer.finish()
@@ -527,6 +542,7 @@ class ShardedJaxLoader(JaxLoaderBase):
             inmemory_cache_all=inmemory_cache_all, pad_spec=pad_spec)
         self._pspec = PartitionSpec(batch_axis)
         self._named_sharding = NamedSharding(mesh, self._pspec)
+        self.stats = self._loader.stats
 
     def _cache_hot(self):
         return self._loader._cache_hot()
@@ -563,11 +579,13 @@ class ShardedJaxLoader(JaxLoaderBase):
                     return
             elif batch is None:
                 return
+            stats = self._loader.stats
             if self._ngram is not None:
-                yield {off: stage_to_global(cols, self._named_sharding)
+                yield {off: stage_to_global(cols, self._named_sharding,
+                                            stats=stats)
                        for off, cols in batch.items()}
             else:
-                yield stage_to_global(batch, self._named_sharding)
+                yield stage_to_global(batch, self._named_sharding, stats=stats)
 
 
 def _all_processes_ready(local_ready: bool) -> bool:
@@ -581,12 +599,14 @@ def _all_processes_ready(local_ready: bool) -> bool:
     return bool(np.asarray(flags).min())
 
 
-def stage_to_global(batch, named_sharding):
+def stage_to_global(batch, named_sharding, stats=None):
     """Assemble a host batch dict into global ``jax.Array``s over
     ``named_sharding``; device-incompatible (string/object) columns ride
     under ``batch['_host']`` untouched — the single definition of the
-    'what can live in HBM' split."""
+    'what can live in HBM' split. ``stats`` (a ``ReaderStats``) accumulates
+    the assembly wall time as ``device_stage_s``."""
     import jax
+    start = time.perf_counter() if stats is not None else 0.0
     device, host = {}, {}
     for name, value in batch.items():
         if _is_device_compatible(value):
@@ -596,6 +616,8 @@ def stage_to_global(batch, named_sharding):
             host[name] = value
     if host:
         device['_host'] = host
+    if stats is not None:
+        stats.add_time('device_stage_s', time.perf_counter() - start)
     return device
 
 
@@ -674,7 +696,7 @@ def prefetch_batches(iterator, size=2):
     return _pipeline(iterator, size, lambda batch: batch)
 
 
-def prefetch_to_device(iterator, size=2, sharding=None):
+def prefetch_to_device(iterator, size=2, sharding=None, stats=None):
     """Double-buffered host→device prefetch.
 
     Stages up to ``size`` batches ahead of the consumer on a background thread
@@ -686,6 +708,9 @@ def prefetch_to_device(iterator, size=2, sharding=None):
 
     :param sharding: optional ``jax.sharding.Sharding`` applied via
         ``jax.device_put`` to plain numpy batches.
+    :param stats: optional ``ReaderStats`` (e.g. ``reader.stats`` /
+        ``loader.stats``) accumulating the transfer-dispatch wall time as
+        ``device_stage_s``.
     """
     import jax
 
@@ -693,13 +718,18 @@ def prefetch_to_device(iterator, size=2, sharding=None):
         # _is_device_compatible reads dtype via getattr: global jax.Arrays must
         # NOT be round-tripped through np.asarray (device->host copy; crashes
         # on non-fully-addressable multi-host arrays).
+        start = time.perf_counter() if stats is not None else 0.0
         if sharding is None:
-            return jax.tree_util.tree_map(
+            staged = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x) if _is_device_compatible(x) else x,
                 batch)
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, sharding) if _is_device_compatible(x) else x,
-            batch)
+        else:
+            staged = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding) if _is_device_compatible(x) else x,
+                batch)
+        if stats is not None:
+            stats.add_time('device_stage_s', time.perf_counter() - start)
+        return staged
 
     return _pipeline(iterator, size, put)
 

@@ -41,7 +41,7 @@ from petastorm_tpu.utils import cast_partition_value
 from petastorm_tpu.workers import EmptyResultError
 from petastorm_tpu.workers.dummy_pool import DummyPool
 from petastorm_tpu.workers.process_pool import ProcessPool
-from petastorm_tpu.workers.serializers import ArrowTableSerializer, PickleSerializer
+from petastorm_tpu.workers.serializers import ArrowTableSerializer, ZeroCopySerializer
 from petastorm_tpu.workers.thread_pool import ThreadPool
 from petastorm_tpu.workers.ventilator import ConcurrentVentilator
 
@@ -131,6 +131,12 @@ def make_reader(dataset_url,
     Mirrors the reference factory (``reader.py:61-195``). Raises a helpful error
     directing to :func:`make_batch_reader` when the store lacks petastorm
     metadata (reference behavior at ``reader.py:128-141``).
+
+    With ``reader_pool_type='process'`` payloads cross the worker boundary
+    over the zero-copy transport: large (≥64 KB) contiguous arrays arrive as
+    **read-only** views over the transport frames (see ``docs/transport.md``).
+    Consumers that mutate samples in place must copy first; batching
+    (``JaxDataLoader`` collation, shuffling buffers) already copies.
     """
     dataset_url = normalize_dataset_url_or_urls(dataset_url)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url, storage_options)
@@ -146,8 +152,10 @@ def make_reader(dataset_url,
 
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings)
+    # ZeroCopySerializer: decoded ndarray payloads cross the process boundary
+    # as out-of-band ZMQ frames instead of being memcpy'd into a pickle blob
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      PickleSerializer(), zmq_copy_buffers, profiling_enabled)
+                      ZeroCopySerializer(), zmq_copy_buffers, profiling_enabled)
     cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
     return Reader(factory, path,
                   worker_class=RowGroupWorker,
@@ -185,6 +193,10 @@ def make_columnar_reader(dataset_url,
     Differences from :func:`make_reader`: ``batched_output=True``; NGram is not
     supported (windows are row-granular); ``TransformSpec.func`` receives a
     dict of column arrays instead of a row dict.
+
+    With ``reader_pool_type='process'`` the published column arrays arrive
+    over the zero-copy transport as **read-only** views over the transport
+    frames (see ``docs/transport.md``); copy before mutating in place.
     """
     dataset_url = normalize_dataset_url_or_urls(dataset_url)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url, storage_options)
@@ -204,7 +216,7 @@ def make_columnar_reader(dataset_url,
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      PickleSerializer(), zmq_copy_buffers, profiling_enabled)
+                      ZeroCopySerializer(), zmq_copy_buffers, profiling_enabled)
     cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
     return Reader(factory, path,
                   worker_class=ColumnarWorker,
@@ -559,8 +571,19 @@ class Reader:
         self.join()
 
     @property
+    def stats(self):
+        """The pool's :class:`~petastorm_tpu.workers.stats.ReaderStats` —
+        the live per-stage telemetry accumulator. The JAX loaders record
+        device staging time into it; ``diagnostics`` snapshots it."""
+        return getattr(self._pool, 'stats', None)
+
+    @property
     def diagnostics(self):
-        return self._pool.diagnostics
+        """Pool accounting plus a :class:`ReaderStats` snapshot: per-stage
+        wall times (``worker_io_s``/``worker_decode_s``/``serialize_s``/
+        ``deserialize_s``/``queue_wait_s``/``device_stage_s``), payload
+        bytes/copies/frames, and queue-occupancy gauges."""
+        return dict(self._pool.diagnostics)
 
 
 def _cast_partition(schema, field_name, value):

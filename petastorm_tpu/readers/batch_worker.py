@@ -93,8 +93,7 @@ class ArrowBatchWorker(ParquetPieceWorker):
 
     def _load_table(self, piece) -> pa.Table:
         columns = self._stored_columns(list(self._schema.fields.keys()), piece)
-        pf = self._parquet_file(piece.path)
-        table = pf.read_row_group(piece.row_group, columns=columns)
+        table = self._read_row_group(piece, columns)
         return self._append_partition_columns(table, piece)
 
     def _load_table_with_predicate(self, piece, predicate) -> pa.Table:
@@ -104,9 +103,8 @@ class ArrowBatchWorker(ParquetPieceWorker):
         (reference :229-288)."""
         from petastorm_tpu.readers.columnar_worker import validate_predicate_fields
         predicate_fields = validate_predicate_fields(predicate, self._full_schema)
-        pf = self._parquet_file(piece.path)
-        pred_stored = pf.read_row_group(
-            piece.row_group, columns=self._stored_columns(predicate_fields, piece))
+        pred_stored = self._read_row_group(
+            piece, self._stored_columns(predicate_fields, piece))
         pred_table = self._append_partition_columns(pred_stored, piece,
                                                     extra_names=set(predicate_fields))
         pred_data = {name: pred_table.column(name).to_pylist() for name in predicate_fields}
@@ -119,7 +117,7 @@ class ArrowBatchWorker(ParquetPieceWorker):
         combined = pred_stored
         other_stored = self._stored_columns(other_names, piece)
         if other_stored:
-            rest = pf.read_row_group(piece.row_group, columns=other_stored)
+            rest = self._read_row_group(piece, other_stored)
             for name in rest.column_names:
                 combined = combined.append_column(name, rest.column(name))
         combined = self._append_partition_columns(combined, piece)

@@ -327,8 +327,7 @@ class ColumnarWorker(ParquetPieceWorker):
 
     def _load(self, piece) -> Dict[str, np.ndarray]:
         names = list(self._schema.fields.keys())
-        table = self._parquet_file(piece.path).read_row_group(
-            piece.row_group, columns=self._stored_columns(names, piece))
+        table = self._read_row_group(piece, self._stored_columns(names, piece))
         columns = self._decode_table(table, names)
         columns.update(self._partition_columns(piece, table.num_rows, set(names)))
         return columns
@@ -338,9 +337,8 @@ class ColumnarWorker(ParquetPieceWorker):
         matching indices (cheaper than the row path, which decodes entire
         predicate rows eagerly)."""
         predicate_fields = validate_predicate_fields(predicate, self._full_schema)
-        pf = self._parquet_file(piece.path)
-        pred_table = pf.read_row_group(
-            piece.row_group, columns=self._stored_columns(predicate_fields, piece))
+        pred_table = self._read_row_group(
+            piece, self._stored_columns(predicate_fields, piece))
         pred_cols = self._decode_table(pred_table, predicate_fields)
         pred_cols.update(self._partition_columns(
             piece, pred_table.num_rows, set(predicate_fields)))
@@ -354,7 +352,7 @@ class ColumnarWorker(ParquetPieceWorker):
         other = [f for f in self._schema.fields if f not in set(predicate_fields)]
         other_stored = self._stored_columns(other, piece)
         if other_stored:
-            rest = pf.read_row_group(piece.row_group, columns=other_stored)
+            rest = self._read_row_group(piece, other_stored)
             rest = rest.take(pa.array(idx))
             out.update(self._decode_table(rest, other_stored))
         out.update(self._partition_columns(piece, len(idx), set(other)))

@@ -4,6 +4,7 @@ ventilated item (file-handle cache, stored-column selection, cache keying)."""
 from __future__ import annotations
 
 import hashlib
+import time
 from typing import Dict, List
 
 import pyarrow.parquet as pq
@@ -45,6 +46,15 @@ class ParquetPieceWorker(WorkerBase):
         """Columns to physically read: requested minus partition-derived."""
         partition_keys = set(piece.partition_dict.keys())
         return [n for n in names if n not in partition_keys]
+
+    def _read_row_group(self, piece, columns: List[str]):
+        """Timed parquet read — the one physical-read call all piece workers
+        share, so ``worker_io_s`` covers every byte read from storage."""
+        start = time.perf_counter()
+        table = self._parquet_file(piece.path).read_row_group(
+            piece.row_group, columns=columns)
+        self.record_time('worker_io_s', time.perf_counter() - start)
+        return table
 
     def _decode_table(self, table, names) -> Dict:
         """Arrow table -> decoded numpy columns for ``names`` (full-schema

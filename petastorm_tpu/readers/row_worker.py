@@ -193,8 +193,7 @@ class RowGroupWorker(ParquetPieceWorker):
     # -- loading ---------------------------------------------------------------
 
     def _read_columns(self, piece, columns: List[str]):
-        pf = self._parquet_file(piece.path)
-        return pf.read_row_group(piece.row_group, columns=columns)
+        return self._read_row_group(piece, columns)
 
     def _decode_with_partitions(self, raw_rows: List[dict], piece, schema) -> List[dict]:
         decoded = []

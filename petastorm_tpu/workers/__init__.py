@@ -13,6 +13,11 @@ class VentilatedItemProcessedMessage:
     """Control message a worker emits after fully processing one ventilated item.
 
     Drives the ventilated-vs-processed accounting that detects end of epoch
-    (reference ``thread_pool.py:155-176``).
+    (reference ``thread_pool.py:155-176``). ``stats`` optionally carries the
+    item's per-stage wall times (``{stage: seconds}``) plus transport counters
+    back across the process boundary; the pool merges it into ``pool.stats``.
     """
-    __slots__ = ()
+    __slots__ = ('stats',)
+
+    def __init__(self, stats=None):
+        self.stats = stats

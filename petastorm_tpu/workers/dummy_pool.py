@@ -11,6 +11,7 @@ import time
 from collections import deque
 
 from petastorm_tpu.workers import EmptyResultError, VentilatedItemProcessedMessage
+from petastorm_tpu.workers.stats import ReaderStats, finalize_item_times
 
 
 class DummyPool:
@@ -19,6 +20,7 @@ class DummyPool:
         self._results_queue = deque()
         self._worker = None
         self._ventilator = None
+        self.stats = ReaderStats()
 
     @property
     def workers_count(self) -> int:
@@ -36,10 +38,16 @@ class DummyPool:
     def get_results(self, timeout=None):
         while True:
             if self._results_queue:
+                self.stats.add('items_out')
                 return self._results_queue.popleft()
             if self._work_queue:
                 args, kwargs = self._work_queue.popleft()
+                start = time.perf_counter()
                 self._worker.process(*args, **kwargs)
+                elapsed = time.perf_counter() - start
+                times = self._worker.drain_stage_times() \
+                    if hasattr(self._worker, 'drain_stage_times') else {}
+                self.stats.merge_times(finalize_item_times(times, elapsed))
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
                 continue
@@ -58,4 +66,6 @@ class DummyPool:
 
     @property
     def diagnostics(self):
-        return {'output_queue_size': len(self._results_queue)}
+        out = {'output_queue_size': len(self._results_queue)}
+        out.update(self.stats.snapshot())
+        return out

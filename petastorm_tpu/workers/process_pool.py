@@ -2,10 +2,20 @@
 
 Reference parity: ``petastorm/workers_pool/process_pool.py`` — three-socket
 topology PUSH(work)/PUB(control)/PULL(results) (:52-74), startup barrier
-(:200-213), multipart ``[payload, control]`` framing (:315-317, :393-404),
-slow-joiner-resistant repeated stop broadcast (:284-301), orphan monitor
-(:320-327,379-382), exception shipping (:260-263,399-405), diagnostics
-(:303-312).
+(:200-213), slow-joiner-resistant repeated stop broadcast (:284-301), orphan
+monitor (:320-327,379-382), exception shipping (:260-263,399-405),
+diagnostics (:303-312).
+
+Deviation from the reference's ``[payload, control]`` framing: results travel
+as ``[meta, control, buf0..bufN]`` multipart messages. Frame 0 is the
+serializer's metadata frame, frame 1 the pickled control marker, and frames
+2+ are out-of-band payload buffers (``ZeroCopySerializer`` ships each
+ndarray/Arrow buffer as its own frame, so payload bytes are never copied
+into a pickle blob). With ``zmq_copy_buffers=False`` the receive side hands
+the serializer ``memoryview``s over the ZMQ frame buffers; each memoryview
+keeps its frame (and the frame its underlying message) alive, so payloads
+reconstructed as views — e.g. ``np.frombuffer`` over a frame — stay valid
+for as long as the consumer holds them.
 
 Workers are spawned as clean CPU-only interpreters via
 :func:`petastorm_tpu.workers.exec_in_new_process.exec_in_new_process` so the
@@ -25,7 +35,8 @@ from typing import Optional
 from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
                                    VentilatedItemProcessedMessage)
 from petastorm_tpu.workers.exec_in_new_process import exec_in_new_process
-from petastorm_tpu.workers.serializers import PickleSerializer
+from petastorm_tpu.workers.serializers import PickleSerializer, as_multipart
+from petastorm_tpu.workers.stats import ReaderStats, finalize_item_times
 
 logger = logging.getLogger(__name__)
 
@@ -36,6 +47,11 @@ _LOCALHOST = 'tcp://127.0.0.1'
 # Control markers travelling in the second multipart frame.
 _DATA = 'DATA'
 _FINISHED = 'FINISHED'
+
+#: Below this total payload size the worker lets ZMQ copy at send time:
+#: zero-copy sends carry per-message bookkeeping (a free-fn callback and a
+#: gc-pinned buffer) that only pays for itself on large frames.
+_ZMQ_NOCOPY_SEND_THRESHOLD = 64 * 1024
 
 
 class _WorkerStarted:
@@ -59,7 +75,7 @@ class ProcessPool:
 
     def __init__(self, workers_count: int, serializer=None, zmq_copy_buffers: bool = True):
         self._workers_count = workers_count
-        self._serializer = serializer or PickleSerializer()
+        self._serializer = as_multipart(serializer or PickleSerializer())
         self._zmq_copy_buffers = zmq_copy_buffers
         self._processes = []
         self._ventilator = None
@@ -74,6 +90,7 @@ class ProcessPool:
         self._processed_items = 0
         self._results_produced = 0
         self._terminated_workers = 0
+        self.stats = ReaderStats()
 
     @property
     def workers_count(self) -> int:
@@ -126,12 +143,26 @@ class ProcessPool:
             ventilator.start()
 
     def _recv_multipart(self):
-        payload, control_bytes = self._results_receiver.recv_multipart(
+        """Receive one ``[meta, control, buf0..bufN]`` message; returns
+        ``(payload_frames, control)`` where ``payload_frames`` is the list of
+        payload buffers (metadata frame first, out-of-band buffers after).
+
+        With ``zmq_copy_buffers=False`` the payload frames are memoryviews
+        over the ZMQ frame buffers. Lifetime: each memoryview references its
+        ``zmq.Frame`` (``memoryview.obj``), which pins the underlying libzmq
+        message — so views the serializer builds over these buffers (numpy
+        ``frombuffer``, ``pa.py_buffer``) remain valid while referenced. The
+        frames list itself must NOT be sliced into raw ``Frame.bytes`` lazily
+        later: converting here, once, is the contract."""
+        frames = self._results_receiver.recv_multipart(
             copy=self._zmq_copy_buffers)
         if not self._zmq_copy_buffers:
-            payload = memoryview(payload.buffer)
-            control_bytes = control_bytes.bytes
-        return payload, pickle.loads(control_bytes)
+            control_bytes = frames[1].bytes
+            payload_frames = [frames[0].buffer] + [f.buffer for f in frames[2:]]
+        else:
+            control_bytes = frames[1]
+            payload_frames = [frames[0]] + frames[2:]
+        return payload_frames, pickle.loads(control_bytes)
 
     def ventilate(self, *args, **kwargs):
         with self._accounting_lock:
@@ -153,15 +184,21 @@ class ProcessPool:
             if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutWaitingForResultError(
                     'No results after {:.1f}s'.format(timeout))
-            if not dict(self._poller.poll(100)):
+            wait_start = time.perf_counter()
+            ready = dict(self._poller.poll(100))
+            self.stats.add_time('queue_wait_s', time.perf_counter() - wait_start)
+            if not ready:
                 if self._all_work_consumed():
                     raise EmptyResultError()
                 self._check_workers_alive()
                 continue
-            payload, control = self._recv_multipart()
+            payload_frames, control = self._recv_multipart()
             if isinstance(control, VentilatedItemProcessedMessage):
                 with self._accounting_lock:
                     self._processed_items += 1
+                    in_flight = self._ventilated_items - self._processed_items
+                self._merge_item_stats(getattr(control, 'stats', None))
+                self.stats.gauge('queue_depth', in_flight)
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
                 # Eager end-of-data check (mirrors ThreadPool.get_results):
@@ -178,8 +215,30 @@ class ProcessPool:
             if control == _DATA:
                 with self._accounting_lock:
                     self._results_produced += 1
-                return self._serializer.deserialize(payload)
+                copies_before = getattr(self._serializer, 'copies', 0)
+                with self.stats.timed('deserialize_s'):
+                    result = self._serializer.deserialize_multipart(payload_frames)
+                # consumer-side deserialize copies count too (worker-side
+                # copies arrive via the accounting message) — the counter
+                # must cover both ends of the hop
+                consumer_copies = getattr(self._serializer, 'copies', 0) - copies_before
+                if consumer_copies:
+                    self.stats.add('payload_copies', consumer_copies)
+                self.stats.add('bytes_moved',
+                               sum(_nbytes(f) for f in payload_frames))
+                self.stats.add('payload_frames', len(payload_frames))
+                self.stats.add('items_out')
+                return result
             # _WorkerStarted duplicates / stray messages are ignored.
+
+    def _merge_item_stats(self, item_stats):
+        if not item_stats:
+            return
+        self.stats.merge_times(item_stats.get('times'))
+        for counter in ('payload_copies',):
+            n = item_stats.get(counter)
+            if n:
+                self.stats.add(counter, n)
 
     def _check_workers_alive(self):
         dead = [p for p in self._processes if p.poll() not in (None, 0)]
@@ -222,12 +281,24 @@ class ProcessPool:
     @property
     def diagnostics(self):
         with self._accounting_lock:
-            return {
+            out = {
                 'items_consumed': self._processed_items,
                 'items_produced': self._results_produced,
                 'items_inprocess': self._ventilated_items - self._processed_items,
                 'zmq_copy_buffers': self._zmq_copy_buffers,
             }
+        out.update(self.stats.snapshot())
+        return out
+
+
+def _nbytes(frame) -> int:
+    nbytes = getattr(frame, 'nbytes', None)
+    if nbytes is not None:
+        return nbytes
+    size = getattr(frame, 'size', None)       # pa.Buffer
+    if isinstance(size, int):
+        return size
+    return len(frame)
 
 
 def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
@@ -248,6 +319,7 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
 
     threading.Thread(target=monitor_parent, daemon=True).start()
 
+    serializer = as_multipart(serializer)
     context = zmq.Context()
     work_receiver = context.socket(zmq.PULL)
     work_receiver.connect(work_addr)
@@ -257,18 +329,33 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
     results_sender = context.socket(zmq.PUSH)
     results_sender.connect(results_addr)
 
-    def send(payload_bytes, control):
-        results_sender.send_multipart([payload_bytes, pickle.dumps(control)])
+    # Per-item stage accounting, shipped back inside the processed-item
+    # control message (the consumer-side pool merges it into its stats).
+    item = {'serialize_s': 0.0, 'publish_wait_s': 0.0, 'copies_before': 0}
+
+    def send(payload_frames, control):
+        message = [payload_frames[0], pickle.dumps(control)] + list(payload_frames[1:])
+        # Zero-copy send for large payloads: libzmq reads the buffers in
+        # place (workers drop their reference right after publishing, so
+        # nothing mutates them post-send). Small/control messages take the
+        # plain copying path.
+        nocopy = sum(_nbytes(f) for f in payload_frames) >= _ZMQ_NOCOPY_SEND_THRESHOLD
+        start = time.perf_counter()
+        results_sender.send_multipart(message, copy=not nocopy)
+        item['publish_wait_s'] += time.perf_counter() - start
 
     def publish(data):
-        send(serializer.serialize(data), _DATA)
+        start = time.perf_counter()
+        frames = serializer.serialize_multipart(data)
+        item['serialize_s'] += time.perf_counter() - start
+        send(frames, _DATA)
 
     try:
         worker = worker_class(worker_id, publish, worker_args)
     except Exception as e:
-        send(b'', _WorkerError(e, traceback.format_exc()))
+        send([b''], _WorkerError(e, traceback.format_exc()))
         return
-    send(b'', _WorkerStarted(worker_id))
+    send([b''], _WorkerStarted(worker_id))
 
     poller = zmq.Poller()
     poller.register(work_receiver, zmq.POLLIN)
@@ -281,14 +368,31 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
                     break
             if work_receiver in socks:
                 args, kwargs = work_receiver.recv_pyobj()
+                item['serialize_s'] = 0.0
+                item['publish_wait_s'] = 0.0
+                item['copies_before'] = getattr(serializer, 'copies', 0)
+                process_start = time.perf_counter()
                 try:
                     worker.process(*args, **kwargs)
                 except Exception as e:
-                    send(b'', _WorkerError(e, traceback.format_exc()))
-                send(b'', VentilatedItemProcessedMessage())
+                    send([b''], _WorkerError(e, traceback.format_exc()))
+                elapsed = time.perf_counter() - process_start
+                times = worker.drain_stage_times() \
+                    if hasattr(worker, 'drain_stage_times') else {}
+                transport = item['serialize_s'] + item['publish_wait_s']
+                times['serialize_s'] = times.get('serialize_s', 0.0) \
+                    + item['serialize_s']
+                times['worker_publish_wait_s'] = \
+                    times.get('worker_publish_wait_s', 0.0) + item['publish_wait_s']
+                finalize_item_times(times, elapsed, transport_s=transport)
+                send([b''], VentilatedItemProcessedMessage(stats={
+                    'times': times,
+                    'payload_copies': getattr(serializer, 'copies', 0)
+                    - item['copies_before'],
+                }))
     finally:
         worker.shutdown()
-        send(b'', _WorkerTerminated(worker_id))
+        send([b''], _WorkerTerminated(worker_id))
         for sock in (work_receiver, control_receiver, results_sender):
             sock.close(linger=1000)
         context.term()

@@ -1,0 +1,120 @@
+"""Per-stage pipeline telemetry shared by every worker pool.
+
+One :class:`ReaderStats` instance lives on each pool (``pool.stats``) and is
+surfaced through ``Reader.diagnostics``. Stages cover the whole path a sample
+travels: parquet read (``worker_io_s``), codec decode (``worker_decode_s``),
+transport serialize/deserialize (process pools), result-queue wait on the
+consumer side, and device staging (``jax_utils`` records into the same
+instance). Counters track payload bytes moved, full-payload memcpys
+(``payload_copies`` — the number the zero-copy transport exists to drive to
+zero), and items delivered; gauges sample queue/buffer occupancy.
+
+Process workers live in other interpreters: they accumulate per-item stage
+times locally and ship them back inside the
+:class:`~petastorm_tpu.workers.VentilatedItemProcessedMessage` control frame,
+which the pool merges here via :meth:`merge_times`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+#: Wall-time stages, in pipeline order. All are seconds.
+TIME_STAGES = (
+    'worker_io_s',       # parquet row-group read inside the worker
+    'worker_decode_s',   # codec decode / transform inside the worker
+    'worker_publish_wait_s',  # worker blocked on a full results queue
+    'serialize_s',       # payload -> transport frames (process pools)
+    'deserialize_s',     # transport frames -> payload (consumer side)
+    'queue_wait_s',      # consumer blocked waiting for a result
+    'device_stage_s',    # host -> device transfer (jax loaders)
+)
+
+#: Monotonic counters.
+COUNTERS = (
+    'bytes_moved',       # payload bytes that crossed the worker->consumer hop
+    'payload_copies',    # full-payload memcpys made by the transport
+    'payload_frames',    # transport frames shipped (multipart parts)
+    'items_out',         # results delivered to the consumer
+)
+
+#: Occupancy gauges; each also keeps a ``<name>_max`` high-water mark.
+GAUGES = ('queue_depth', 'shuffle_buffer_depth')
+
+
+class ReaderStats:
+    """Thread-safe per-stage accumulator. All keys exist from construction so
+    ``snapshot()`` has a stable schema regardless of pool type."""
+
+    __slots__ = ('_lock', '_times', '_counts', '_gauges')
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._times = {stage: 0.0 for stage in TIME_STAGES}
+        self._counts = {name: 0 for name in COUNTERS}
+        self._gauges = {}
+        for name in GAUGES:
+            self._gauges[name] = 0
+            self._gauges[name + '_max'] = 0
+
+    def add_time(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            self._times[stage] = self._times.get(stage, 0.0) + seconds
+
+    def merge_times(self, stage_seconds) -> None:
+        """Accumulate a ``{stage: seconds}`` mapping (shipped back from a
+        process worker)."""
+        if not stage_seconds:
+            return
+        with self._lock:
+            for stage, seconds in stage_seconds.items():
+                self._times[stage] = self._times.get(stage, 0.0) + seconds
+
+    def add(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[counter] = self._counts.get(counter, 0) + n
+
+    def gauge(self, name: str, value) -> None:
+        with self._lock:
+            self._gauges[name] = value
+            key = name + '_max'
+            if value > self._gauges.get(key, 0):
+                self._gauges[key] = value
+
+    @contextmanager
+    def timed(self, stage: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_time(stage, time.perf_counter() - start)
+
+    def snapshot(self) -> dict:
+        """One flat dict of every stage/counter/gauge (stable key set)."""
+        with self._lock:
+            out = dict(self._times)
+            out.update(self._counts)
+            out.update(self._gauges)
+        return out
+
+
+def finalize_item_times(times: dict, elapsed: float,
+                        transport_s: float = 0.0) -> dict:
+    """Derive ``worker_decode_s`` for one processed item so the stages sum
+    sanely: decode = total ``process()`` wall time minus transport time
+    (serialize + publish wait) minus the already-itemized io read time.
+    Mutates and returns ``times`` (the worker's drained stage dict). The one
+    definition shared by the thread/process/dummy pools."""
+    times['worker_decode_s'] = times.get('worker_decode_s', 0.0) \
+        + max(0.0, elapsed - transport_s - times.get('worker_io_s', 0.0))
+    return times
+
+
+def stage_keys() -> tuple:
+    """The stable key set of :meth:`ReaderStats.snapshot` (tests assert it)."""
+    keys = list(TIME_STAGES) + list(COUNTERS)
+    for name in GAUGES:
+        keys.extend((name, name + '_max'))
+    return tuple(keys)

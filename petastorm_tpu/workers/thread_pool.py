@@ -18,11 +18,13 @@ import pstats
 import queue
 import sys
 import threading
+import time
 import traceback
 from typing import Optional
 
 from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
                                    VentilatedItemProcessedMessage)
+from petastorm_tpu.workers.stats import ReaderStats, finalize_item_times
 
 logger = logging.getLogger(__name__)
 
@@ -39,27 +41,40 @@ class _WorkerException:
 
 
 class WorkerThread(threading.Thread):
-    def __init__(self, pool: 'ThreadPool', worker, profiling_enabled: bool):
+    def __init__(self, pool: 'ThreadPool', worker, profiling_enabled: bool,
+                 publish_wait: dict):
         super().__init__(daemon=True, name='petastorm-tpu-worker-{}'.format(worker.worker_id))
         self._pool = pool
         self._worker = worker
+        self._publish_wait = publish_wait  # {'s': float}, fed by the publish wrapper
         self._profiler = cProfile.Profile() if profiling_enabled else None
 
     def run(self):
         if self._profiler:
             self._profiler.enable()
+        stats = self._pool.stats
         try:
             while True:
                 item = self._pool._work_queue.get()
                 if item is _SENTINEL:
                     break
                 args, kwargs = item
+                wait_before = self._publish_wait['s']
+                start = time.perf_counter()
                 try:
                     self._worker.process(*args, **kwargs)
                 except Exception as e:  # ship to consumer; keep serving
                     logger.debug('Worker %s raised:\n%s', self._worker.worker_id,
                                  traceback.format_exc())
                     self._pool._put_result(_WorkerException(e))
+                elapsed = time.perf_counter() - start
+                times = self._worker.drain_stage_times() \
+                    if hasattr(self._worker, 'drain_stage_times') else {}
+                publish_wait = self._publish_wait['s'] - wait_before
+                times['worker_publish_wait_s'] = \
+                    times.get('worker_publish_wait_s', 0.0) + publish_wait
+                stats.merge_times(finalize_item_times(times, elapsed,
+                                                      transport_s=publish_wait))
                 self._pool._put_result(VentilatedItemProcessedMessage())
         finally:
             if self._profiler:
@@ -85,6 +100,7 @@ class ThreadPool:
         self._accounting_lock = threading.Lock()
         self._ventilated_items = 0
         self._processed_items = 0
+        self.stats = ReaderStats()
 
     @property
     def workers_count(self) -> int:
@@ -93,8 +109,19 @@ class ThreadPool:
     def start(self, worker_class, worker_args=None, ventilator=None):
         self._ventilator = ventilator
         for worker_id in range(self._workers_count):
-            worker = worker_class(worker_id, self._put_result, worker_args)
-            thread = WorkerThread(self, worker, self._profiling_enabled)
+            # Per-worker publish wrapper: time spent blocked on a full results
+            # queue is back-pressure, not decode; the worker thread subtracts
+            # it from its process() wall time.
+            publish_wait = {'s': 0.0}
+
+            def publish(item, _wait=publish_wait):
+                start = time.perf_counter()
+                self._put_result(item)
+                _wait['s'] += time.perf_counter() - start
+
+            worker = worker_class(worker_id, publish, worker_args)
+            thread = WorkerThread(self, worker, self._profiling_enabled,
+                                  publish_wait)
             self._threads.append(thread)
             thread.start()
         if ventilator is not None:
@@ -125,15 +152,19 @@ class ThreadPool:
         return True
 
     def get_results(self, timeout: Optional[float] = None):
-        import time
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutWaitingForResultError(
                     'No results after {:.1f}s'.format(timeout))
             try:
+                wait_start = time.perf_counter()
                 item = self._results_queue.get(timeout=0.1)
+                self.stats.add_time('queue_wait_s',
+                                    time.perf_counter() - wait_start)
             except queue.Empty:
+                self.stats.add_time('queue_wait_s',
+                                    time.perf_counter() - wait_start)
                 if self._all_work_consumed() and self._results_queue.empty():
                     raise EmptyResultError()
                 continue
@@ -153,6 +184,8 @@ class ThreadPool:
                 self.stop()
                 sys.stderr.write(item.formatted)
                 raise item.exc
+            self.stats.gauge('queue_depth', self._results_queue.qsize())
+            self.stats.add('items_out')
             return item
 
     def stop(self):
@@ -183,4 +216,6 @@ class ThreadPool:
 
     @property
     def diagnostics(self):
-        return {'output_queue_size': self._results_queue.qsize()}
+        out = {'output_queue_size': self._results_queue.qsize()}
+        out.update(self.stats.snapshot())
+        return out

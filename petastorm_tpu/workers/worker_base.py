@@ -11,11 +11,26 @@ class WorkerBase(ABC):
         self.worker_id = worker_id
         self.publish_func = publish_func
         self.args = args
+        #: Per-stage wall time accumulated since the last drain; the owning
+        #: pool drains it after each processed item (thread pools merge it
+        #: straight into ``pool.stats``, process pools ship it back in the
+        #: accounting control message).
+        self.stage_times = {}
 
     @abstractmethod
     def process(self, *args, **kwargs):
         """Process one ventilated work item; call ``self.publish_func(result)``
         zero or more times."""
+
+    def record_time(self, stage: str, seconds: float) -> None:
+        """Accumulate ``seconds`` of wall time against a pipeline stage
+        (see :mod:`petastorm_tpu.workers.stats` for the stage names)."""
+        self.stage_times[stage] = self.stage_times.get(stage, 0.0) + seconds
+
+    def drain_stage_times(self) -> dict:
+        """Return and reset the accumulated per-stage times."""
+        times, self.stage_times = self.stage_times, {}
+        return times
 
     def shutdown(self):
         """Optional cleanup hook invoked when the pool stops."""
