@@ -115,6 +115,12 @@ def main():
     from petastorm_tpu.benchmark.transport import run_transport_bench
     transport = run_transport_bench(quick=True)
 
+    # -- readahead: serial vs prefetched row-group reads --------------------
+    # Slow-IO shim pins io:decode at ~1:1; the quick mode keeps the stable
+    # signals (speedup over serial, overlap fraction, hit rate) in seconds.
+    from petastorm_tpu.benchmark.readahead import run_readahead_bench
+    readahead = run_readahead_bench(quick=True)
+
     # -- north-star: train-step infeed overlap ------------------------------
     # Accelerator-scale configs for any non-CPU backend; dataset paths carry
     # the size parameters so a platform change can't reuse a stale store.
@@ -289,6 +295,7 @@ def main():
         'vs_baseline': round(median / BASELINE_SAMPLES_PER_SEC, 3),
         'dispersion': dispersion,
         'transport': transport,
+        'readahead': readahead,
         'northstar': {
             'platform': platform,
             'mnist_train': mnist.as_dict(),

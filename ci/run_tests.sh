@@ -31,6 +31,9 @@ case "$LANE" in
     ;;
 esac
 
+echo '== readahead quick bench (serial vs prefetched row-group reads) =='
+python -m petastorm_tpu.benchmark.readahead --quick
+
 echo '== bench-docs consistency gate =='
 python ci/check_bench_docs.py
 

@@ -30,6 +30,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help='Use make_batch_reader (vectorized path)')
     parser.add_argument('--read-method', default='python',
                         choices=['python', 'jax'])
+    parser.add_argument('--io-readahead', default='0',
+                        help="per-worker row-group read prefetch depth: an "
+                             "int or 'auto' (overlap storage I/O with "
+                             "decode; see docs/readahead.md)")
     parser.add_argument('--jax-batch-size', type=int, default=16)
     parser.add_argument('-r', '--runs', type=int, default=1,
                         help='Repeat the measurement N times and report '
@@ -46,13 +50,16 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.v:
         logging.basicConfig(level=logging.INFO)
+    io_readahead = (args.io_readahead if args.io_readahead == 'auto'
+                    else int(args.io_readahead))
     results = [reader_throughput(
         args.dataset_url, field_regex=args.field_regex,
         warmup_cycles=args.warmup_cycles, measure_cycles=args.measure_cycles,
         pool_type=args.pool_type, workers_count=args.workers_count,
         shuffling_queue_size=args.shuffling_queue_size,
         read_method=args.read_method, batch_reader=args.batch_reader,
-        jax_batch_size=args.jax_batch_size) for _ in range(max(1, args.runs))]
+        jax_batch_size=args.jax_batch_size,
+        io_readahead=io_readahead) for _ in range(max(1, args.runs))]
     # headline = median run: the honest central figure (best would overstate)
     by_rate = sorted(results, key=lambda r: r.samples_per_sec)
     result = by_rate[len(by_rate) // 2]

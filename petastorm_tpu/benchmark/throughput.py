@@ -51,7 +51,8 @@ def reader_throughput(dataset_url: str,
                       shuffling_queue_size: int = 500,
                       read_method: str = 'python',
                       batch_reader: bool = False,
-                      jax_batch_size: int = 0) -> ThroughputResult:
+                      jax_batch_size: int = 0,
+                      io_readahead=0) -> ThroughputResult:
     """Measure reader throughput on ``dataset_url``.
 
     ``read_method='python'`` iterates raw reader rows/batches;
@@ -62,7 +63,7 @@ def reader_throughput(dataset_url: str,
 
     factory = make_batch_reader if batch_reader else make_reader
     kwargs = dict(reader_pool_type=pool_type, workers_count=workers_count,
-                  num_epochs=None)
+                  num_epochs=None, io_readahead=io_readahead)
     if field_regex is not None:
         kwargs['schema_fields'] = field_regex
 

@@ -621,6 +621,57 @@ def stage_to_global(batch, named_sharding, stats=None):
     return device
 
 
+def infeed_diagnosis(snapshot: dict) -> dict:
+    """Classify an infeed pipeline from a ``ReaderStats`` snapshot
+    (``reader.diagnostics`` / ``loader.stats.snapshot()``) and recommend the
+    knobs that attack its bottleneck.
+
+    The signatures (see ``docs/troubleshooting.md``):
+
+    - **io-bound** — storage stall dominates decode: raise ``io_readahead``
+      (overlap reads with decode) before raising ``workers_count``.
+    - **decode-bound** — decode dominates and reads are already hidden:
+      ``io_readahead`` cannot help; raise ``workers_count`` / move decode
+      work (decode_hints, transforms) instead.
+    - **consumer-bound** — workers outrun the consumer (large
+      ``worker_publish_wait_s``): the training step, not the reader, is the
+      ceiling.
+    """
+    from petastorm_tpu.workers.stats import (effective_io_s,
+                                             readahead_hit_rate,
+                                             recommend_io_readahead)
+    io_s = effective_io_s(snapshot)
+    decode_s = snapshot.get('worker_decode_s', 0.0)
+    publish_wait_s = snapshot.get('worker_publish_wait_s', 0.0)
+    busy = io_s + decode_s
+    if publish_wait_s > busy:
+        bottleneck = 'consumer'
+        hint = ('workers outrun the consumer (publish_wait > io+decode): '
+                'the training step / consumer loop is the ceiling')
+    elif io_s > decode_s * 1.5:
+        bottleneck = 'io'
+        hint = ('storage stall dominates: raise io_readahead (or pass '
+                "io_readahead='auto') before raising workers_count")
+    elif decode_s > io_s * 1.5:
+        bottleneck = 'decode'
+        hint = ('decode dominates and reads are hidden: raise workers_count '
+                'or cut decode work (decode_hints, lighter transforms)')
+    else:
+        bottleneck = 'balanced'
+        hint = ('io and decode are comparable: io_readahead overlaps them '
+                'for up to 2x; workers_count scales both')
+    return {
+        'bottleneck': bottleneck,
+        'io_s': round(io_s, 4),
+        'decode_s': round(decode_s, 4),
+        'io_decode_ratio': round(io_s / decode_s, 3) if decode_s else None,
+        'io_overlap_fraction': snapshot.get('io_overlap_fraction', 0.0),
+        'readahead_hit_rate': readahead_hit_rate(snapshot),
+        'recommended_io_readahead': recommend_io_readahead(snapshot),
+        'hint': hint,
+    }
+
+
 def make_jax_loader(reader, batch_size=1, mesh=None, batch_axis='data',
                     shuffling_queue_capacity=0, transform_fn=None,
                     drop_last=False, seed=None, inmemory_cache_all=False,

@@ -52,6 +52,20 @@ logger = logging.getLogger(__name__)
 _VENTILATE_EXTRA_ROWGROUPS = 2
 
 
+def _validate_io_readahead(io_readahead):
+    """Normalize the ``io_readahead`` knob: 0/None disables, a positive int is
+    a fixed per-worker prefetch depth, ``'auto'`` sizes it live from the
+    worker's measured io:decode ratio."""
+    if io_readahead in (None, 0):
+        return 0
+    if io_readahead == 'auto':
+        return 'auto'
+    if isinstance(io_readahead, int) and io_readahead > 0:
+        return io_readahead
+    raise ValueError("io_readahead must be a non-negative int or 'auto', got "
+                     '{!r}'.format(io_readahead))
+
+
 def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
                 cache_extra_settings):
     if cache_type in (None, 'null'):
@@ -125,7 +139,8 @@ def make_reader(dataset_url,
                 cache_row_size_estimate=None, cache_extra_settings=None,
                 transform_spec=None, filters=None,
                 storage_options=None, zmq_copy_buffers=True,
-                profiling_enabled=False, decode_hints=None):
+                profiling_enabled=False, decode_hints=None,
+                io_readahead=0):
     """Row-granular reader for petastorm_tpu datasets (codec-decoded rows).
 
     Mirrors the reference factory (``reader.py:61-195``). Raises a helpful error
@@ -137,6 +152,11 @@ def make_reader(dataset_url,
     **read-only** views over the transport frames (see ``docs/transport.md``).
     Consumers that mutate samples in place must copy first; batching
     (``JaxDataLoader`` collation, shuffling buffers) already copies.
+
+    ``io_readahead=K`` gives each worker a background reader that prefetches
+    the parquet reads of its next K ventilated pieces while it decodes the
+    current one, overlapping storage latency with decode CPU; ``'auto'``
+    sizes K from the live io:decode ratio (see ``docs/readahead.md``).
     """
     dataset_url = normalize_dataset_url_or_urls(dataset_url)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url, storage_options)
@@ -166,7 +186,8 @@ def make_reader(dataset_url,
                   predicate=predicate, rowgroup_selector=rowgroup_selector,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec, filters=filters,
-                  pool=pool, is_batched_reader=False, decode_hints=decode_hints)
+                  pool=pool, is_batched_reader=False, decode_hints=decode_hints,
+                  io_readahead=io_readahead)
 
 
 def make_columnar_reader(dataset_url,
@@ -182,7 +203,8 @@ def make_columnar_reader(dataset_url,
                          cache_row_size_estimate=None, cache_extra_settings=None,
                          transform_spec=None, filters=None,
                          storage_options=None, zmq_copy_buffers=True,
-                         profiling_enabled=False, decode_hints=None):
+                         profiling_enabled=False, decode_hints=None,
+                         io_readahead=0):
     """Vectorized codec-decoded reader for petastorm_tpu datasets.
 
     Yields **batch namedtuples of decoded numpy column arrays** (one per row
@@ -227,7 +249,8 @@ def make_columnar_reader(dataset_url,
                   predicate=predicate, rowgroup_selector=rowgroup_selector,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec, filters=filters,
-                  pool=pool, is_batched_reader=True, decode_hints=decode_hints)
+                  pool=pool, is_batched_reader=True, decode_hints=decode_hints,
+                  io_readahead=io_readahead)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -241,10 +264,11 @@ def make_batch_reader(dataset_url_or_urls,
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None, filters=None,
                       storage_options=None, zmq_copy_buffers=True,
-                      profiling_enabled=False):
+                      profiling_enabled=False, io_readahead=0):
     """Vectorized batch reader for arbitrary parquet stores
     (reference ``reader.py:198-327``). Yields namedtuples of column arrays,
-    one per row group."""
+    one per row group. ``io_readahead`` prefetches upcoming row-group reads
+    per worker (see :func:`make_reader`)."""
     dataset_url_or_urls = normalize_dataset_url_or_urls(dataset_url_or_urls)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url_or_urls,
                                                          storage_options)
@@ -267,7 +291,7 @@ def make_batch_reader(dataset_url_or_urls,
                   predicate=predicate, rowgroup_selector=None,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec, filters=filters,
-                  pool=pool, is_batched_reader=True)
+                  pool=pool, is_batched_reader=True, io_readahead=io_readahead)
 
 
 class Reader:
@@ -279,7 +303,8 @@ class Reader:
                  shuffle_row_drop_partitions=1, predicate=None, rowgroup_selector=None,
                  num_epochs=1, cur_shard=None, shard_count=None,
                  cache=None, transform_spec=None, filters=None,
-                 pool=None, is_batched_reader=False, decode_hints=None):
+                 pool=None, is_batched_reader=False, decode_hints=None,
+                 io_readahead=0):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -374,10 +399,27 @@ class Reader:
                               'worker_predicate': piece_predicate,
                               'shuffle_row_drop_partition': (
                                   drop_partition, shuffle_row_drop_partitions)})
+        # The in-flight bound must cover every worker's prefetch window or
+        # the ventilator starves the readahead: each worker holds its current
+        # item plus up to `lookahead` hinted ones.
+        io_readahead = _validate_io_readahead(io_readahead)
+        if io_readahead and not getattr(pool, 'supports_prefetch_hints', False):
+            # a pool that never hints (dummy) would record every read as a
+            # readahead miss — misleading diagnostics plus dead threads
+            logger.debug('io_readahead disabled: %s does not hint workers '
+                         'about upcoming items', type(pool).__name__)
+            io_readahead = 0
+        if io_readahead:
+            from petastorm_tpu.readers.readahead import AUTO_MAX_DEPTH
+            lookahead = (AUTO_MAX_DEPTH if io_readahead == 'auto'
+                         else io_readahead)
+        else:
+            lookahead = 0
         self._ventilator = ConcurrentVentilator(
             pool.ventilate, items, iterations=num_epochs,
             randomize_item_order=shuffle_row_groups, random_seed=seed,
-            max_ventilation_queue_size=pool.workers_count + _VENTILATE_EXTRA_ROWGROUPS)
+            max_ventilation_queue_size=(
+                pool.workers_count * (1 + lookahead) + _VENTILATE_EXTRA_ROWGROUPS))
 
         worker_args = {
             'filesystem_factory': filesystem_factory,
@@ -390,6 +432,7 @@ class Reader:
             'transform_spec': transform_spec,
             'transformed_schema': transformed_schema,
             'decode_hints': decode_hints,
+            'io_readahead': io_readahead,
         }
         # fail fast on bad hints (workers rebuild these after unpickling)
         build_decode_overrides(stored_schema, decode_hints)

@@ -91,6 +91,10 @@ class ArrowBatchWorker(ParquetPieceWorker):
             table = table.append_column(key, pa.array(col))
         return table
 
+    def _planned_columns(self, piece):
+        # the no-predicate path reads exactly _load_table's column list
+        return self._stored_columns(list(self._schema.fields.keys()), piece)
+
     def _load_table(self, piece) -> pa.Table:
         columns = self._stored_columns(list(self._schema.fields.keys()), piece)
         table = self._read_row_group(piece, columns)

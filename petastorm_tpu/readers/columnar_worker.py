@@ -325,6 +325,10 @@ class ColumnarWorker(ParquetPieceWorker):
     def _partition_columns(self, piece, n: int, names) -> Dict[str, np.ndarray]:
         return make_partition_columns(self._full_schema, piece, n, names)
 
+    def _planned_columns(self, piece):
+        # every no-predicate branch of process() funnels through _load()
+        return self._stored_columns(list(self._schema.fields.keys()), piece)
+
     def _load(self, piece) -> Dict[str, np.ndarray]:
         names = list(self._schema.fields.keys())
         table = self._read_row_group(piece, self._stored_columns(names, piece))

@@ -1,15 +1,84 @@
 """Shared plumbing for workers that process one parquet row-group piece per
-ventilated item (file-handle cache, stored-column selection, cache keying)."""
+ventilated item (file-handle cache, stored-column selection, cache keying,
+and the row-group readahead that overlaps storage I/O with decode)."""
 
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
-from typing import Dict, List
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 import pyarrow.parquet as pq
 
+from petastorm_tpu.cache import NullCache
 from petastorm_tpu.workers.worker_base import WorkerBase
+
+#: Bound on per-worker open parquet file handles. Many-file datasets used to
+#: grow ``_open_files`` without limit — one handle (buffered reader + footer
+#: metadata) per file ever touched, times workers. 32 keeps the common
+#: few-files-per-shard case fully cached while bounding the many-file case.
+FILE_HANDLE_CACHE_SIZE = 32
+
+#: fsspec protocols that read from local memory/disk; everything else is
+#: treated as remote storage where ``pre_buffer`` (coalesced column-chunk
+#: reads) pays for itself.
+_LOCAL_PROTOCOLS = frozenset({'file', 'local', 'memory'})
+
+
+class FileHandleCache:
+    """Small LRU of open :class:`pq.ParquetFile` handles, closing evictees.
+
+    Each cache instance is owned by exactly one reading thread (the worker
+    thread and the readahead thread hold disjoint instances, because a
+    ``ParquetFile`` must not serve two concurrent reads); the lock only
+    guards the bookkeeping so occupancy can be inspected cross-thread.
+    """
+
+    def __init__(self, open_fn, max_size: int = FILE_HANDLE_CACHE_SIZE):
+        if max_size < 1:
+            raise ValueError('max_size must be >= 1, got {}'.format(max_size))
+        self._open_fn = open_fn
+        self._max_size = max_size
+        self._entries: 'OrderedDict[str, pq.ParquetFile]' = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, path: str) -> pq.ParquetFile:
+        with self._lock:
+            handle = self._entries.get(path)
+            if handle is not None:
+                self._entries.move_to_end(path)
+                return handle
+        handle = self._open_fn(path)
+        evicted = []
+        with self._lock:
+            raced = self._entries.get(path)
+            if raced is not None:
+                self._entries.move_to_end(path)
+                evicted.append(handle)   # lost a race; keep the cached one
+                handle = raced
+            else:
+                self._entries[path] = handle
+                while len(self._entries) > self._max_size:
+                    evicted.append(self._entries.popitem(last=False)[1])
+        for old in evicted:
+            old.close()
+        return handle
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, path: str) -> bool:
+        with self._lock:
+            return path in self._entries
+
+    def close_all(self) -> None:
+        with self._lock:
+            entries, self._entries = self._entries, OrderedDict()
+        for handle in entries.values():
+            handle.close()
 
 
 class ParquetPieceWorker(WorkerBase):
@@ -31,25 +100,130 @@ class ParquetPieceWorker(WorkerBase):
         self._decode_hints = args.get('decode_hints')
         self._decode_overrides = build_decode_overrides(
             self._full_schema, self._decode_hints)
-        self._open_files: Dict[str, pq.ParquetFile] = {}
+        # pre_buffer coalesces a row group's column chunks into few large
+        # ranged reads — the right shape for object stores (GCS/S3/HDFS),
+        # pure overhead for local mmap-fast files
+        protocol = getattr(self._filesystem, 'protocol', '')
+        if isinstance(protocol, (tuple, list)):
+            protocol = protocol[0] if protocol else ''
+        self._pre_buffer = protocol not in _LOCAL_PROTOCOLS
+        self._open_files = FileHandleCache(self._open_parquet)
+        # cache-key components are per-worker constants: hash them once, not
+        # per ventilated piece
+        self._dataset_path_digest = hashlib.md5(
+            str(self._dataset_path).encode()).hexdigest()
+        self._decode_hints_digest = ''
+        if self._decode_hints:
+            self._decode_hints_digest = ':' + hashlib.md5(
+                repr(sorted((k, sorted(v.items()))
+                            for k, v in self._decode_hints.items())).encode()
+            ).hexdigest()[:12]
+        # -- readahead (see petastorm_tpu/readers/readahead.py) ----------------
+        self._readahead = None
+        self._prefetch_files: Optional[FileHandleCache] = None
+        depth = args.get('io_readahead') or 0
+        if depth:
+            from petastorm_tpu.readers.readahead import RowGroupReadahead
+            # the background thread gets its own handle cache: a ParquetFile
+            # must never serve two concurrent reads
+            self._prefetch_files = FileHandleCache(self._open_parquet)
+            self._readahead = RowGroupReadahead(self._readahead_read, depth)
 
     def shutdown(self):
-        for f in self._open_files.values():
-            f.close()
+        if self._readahead is not None:
+            self._readahead.stop()
+        if self._prefetch_files is not None:
+            self._prefetch_files.close_all()
+        self._open_files.close_all()
+
+    def _open_parquet(self, path: str) -> pq.ParquetFile:
+        handle = self._filesystem.open(path, 'rb')
+        if self._pre_buffer:
+            try:
+                return pq.ParquetFile(handle, pre_buffer=True)
+            except TypeError:  # pyarrow predating the kwarg
+                pass
+        return pq.ParquetFile(handle)
 
     def _parquet_file(self, path: str) -> pq.ParquetFile:
-        if path not in self._open_files:
-            self._open_files[path] = pq.ParquetFile(self._filesystem.open(path, 'rb'))
-        return self._open_files[path]
+        return self._open_files.get(path)
 
     def _stored_columns(self, names: List[str], piece) -> List[str]:
         """Columns to physically read: requested minus partition-derived."""
         partition_keys = set(piece.partition_dict.keys())
         return [n for n in names if n not in partition_keys]
 
+    # -- readahead -------------------------------------------------------------
+
+    @property
+    def prefetch_lookahead(self) -> int:
+        """How many upcoming ventilated items the owning pool should hold back
+        and pass to :meth:`prefetch_hint` (0 disables the pool's lookahead)."""
+        return self._readahead.depth if self._readahead is not None else 0
+
+    def prefetch_hint(self, upcoming_items) -> None:
+        """Called by the pool's worker loop with the ordered ``(args, kwargs)``
+        of the items this worker will process next; schedules background
+        reads for the plannable ones."""
+        if self._readahead is None:
+            return
+        plans = []
+        for item_args, item_kwargs in upcoming_items:
+            plan = self._plan_item(item_args, item_kwargs)
+            if plan is not None:
+                plans.append(plan)
+        self._readahead.sync(plans)
+
+    def _plan_item(self, item_args, item_kwargs) -> Optional[Tuple]:
+        """``(key, piece, columns)`` of the primary read a future
+        ``process(*item_args, **item_kwargs)`` call will issue, or ``None``
+        when the item is not prefetchable (predicate items read in multiple
+        dependent phases; cached items may skip the read entirely)."""
+        params = dict(zip(('piece_index', 'worker_predicate',
+                           'shuffle_row_drop_partition'), item_args))
+        params.update(item_kwargs)
+        if params.get('worker_predicate') is not None:
+            return None
+        if not isinstance(self._local_cache, NullCache):
+            return None
+        piece_index = params.get('piece_index')
+        if piece_index is None:
+            return None
+        piece = self._split_pieces[piece_index]
+        columns = self._planned_columns(piece)
+        if columns is None:
+            return None
+        return self._read_key(piece, columns), piece, columns
+
+    def _planned_columns(self, piece) -> Optional[List[str]]:
+        """The exact column list the subclass's no-predicate load will pass to
+        :meth:`_read_row_group` for ``piece`` (``None`` = not plannable).
+        Overridden per worker type."""
+        return None
+
+    @staticmethod
+    def _read_key(piece, columns: List[str]) -> Tuple:
+        return (piece.path, piece.row_group, tuple(columns))
+
+    def _readahead_read(self, piece, columns: List[str]):
+        """The background thread's read path — its own file handles, no shared
+        state with the worker thread."""
+        return self._prefetch_files.get(piece.path).read_row_group(
+            piece.row_group, columns=columns)
+
+    # -- reads -----------------------------------------------------------------
+
     def _read_row_group(self, piece, columns: List[str]):
         """Timed parquet read — the one physical-read call all piece workers
-        share, so ``worker_io_s`` covers every byte read from storage."""
+        share, so ``worker_io_s`` covers every byte read from storage. With
+        readahead enabled, prefetched reads are consumed here (only the
+        blocked wait, if any, lands in ``worker_io_s``); unplanned reads fall
+        back inline."""
+        if self._readahead is not None:
+            table = self._readahead.take(self._read_key(piece, columns))
+            self._readahead.drain_stats_into(self)
+            if table is not None:
+                return table
         start = time.perf_counter()
         table = self._parquet_file(piece.path).read_row_group(
             piece.row_group, columns=columns)
@@ -74,12 +248,6 @@ class ParquetPieceWorker(WorkerBase):
         # decode_hints change what a decoded row group contains (e.g. image
         # resolution) — they must partition the cache, or a reader with
         # different hints would be served wrong-resolution data
-        hints = ''
-        if self._decode_hints:
-            hints = ':' + hashlib.md5(
-                repr(sorted((k, sorted(v.items()))
-                            for k, v in self._decode_hints.items())).encode()
-            ).hexdigest()[:12]
         return '{}:{}:{}:{}{}'.format(
-            prefix, hashlib.md5(str(self._dataset_path).encode()).hexdigest(),
-            piece.path, piece.row_group, hints)
+            prefix, self._dataset_path_digest,
+            piece.path, piece.row_group, self._decode_hints_digest)

@@ -192,6 +192,22 @@ class RowGroupWorker(ParquetPieceWorker):
 
     # -- loading ---------------------------------------------------------------
 
+    def _planned_columns(self, piece):
+        """Mirror the primary read of each no-predicate branch of
+        :meth:`process` so the readahead prefetches the exact same column
+        list (key equality is what turns a prefetch into a hit)."""
+        if self._ngram is not None and self._transform_spec is None:
+            # columnar window-chunk path (_load_window_columns)
+            names = [n for n in self._ngram.get_all_field_names()
+                     if n in self._full_schema.fields]
+        elif self._ngram is not None:
+            # ngram fallback row path (_load_rows with ngram)
+            names = [n for n in self._ngram.get_all_field_names()
+                     if n in self._schema.fields or n in self._full_schema.fields]
+        else:
+            names = list(self._schema.fields.keys())
+        return self._stored_columns(names, piece)
+
     def _read_columns(self, piece, columns: List[str]):
         return self._read_row_group(piece, columns)
 

@@ -48,6 +48,10 @@ class DummyPool:
                 times = self._worker.drain_stage_times() \
                     if hasattr(self._worker, 'drain_stage_times') else {}
                 self.stats.merge_times(finalize_item_times(times, elapsed))
+                if hasattr(self._worker, 'drain_stat_counts'):
+                    counts, gauges = self._worker.drain_stat_counts()
+                    self.stats.merge_counts(counts)
+                    self.stats.merge_gauges(gauges)
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
                 continue

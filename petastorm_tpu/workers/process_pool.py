@@ -73,6 +73,10 @@ class _WorkerError:
 class ProcessPool:
     """Process-based pool implementing the ventilate/get_results protocol."""
 
+    #: The worker bootstrap passes upcoming items to ``worker.prefetch_hint``
+    #: — readers may enable ``io_readahead`` on this pool.
+    supports_prefetch_hints = True
+
     def __init__(self, workers_count: int, serializer=None, zmq_copy_buffers: bool = True):
         self._workers_count = workers_count
         self._serializer = as_multipart(serializer or PickleSerializer())
@@ -235,6 +239,8 @@ class ProcessPool:
         if not item_stats:
             return
         self.stats.merge_times(item_stats.get('times'))
+        self.stats.merge_counts(item_stats.get('counts'))
+        self.stats.merge_gauges(item_stats.get('gauges'))
         for counter in ('payload_copies',):
             n = item_stats.get(counter)
             if n:
@@ -360,36 +366,67 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
     poller = zmq.Poller()
     poller.register(work_receiver, zmq.POLLIN)
     poller.register(control_receiver, zmq.POLLIN)
+    # Readahead lookahead: ZMQ PUSH round-robins items to worker PULL sockets
+    # at send time, so everything this socket holds is already this worker's.
+    # Workers exposing prefetch_lookahead > 0 drain up to that many extra
+    # items into a local FIFO and get hinted about them before processing the
+    # head, letting their background reader overlap the next reads with the
+    # current decode.
+    from collections import deque
+    pending = deque()
+    hint = getattr(worker, 'prefetch_hint', None)
     try:
         while True:
-            socks = dict(poller.poll())
+            # block only when there is nothing to process; otherwise just
+            # drain whatever already arrived
+            socks = dict(poller.poll(None if not pending else 0))
             if control_receiver in socks:
                 if control_receiver.recv_pyobj() == _FINISHED:
-                    break
+                    break   # drop un-processed lookahead items: pool stopping
             if work_receiver in socks:
-                args, kwargs = work_receiver.recv_pyobj()
-                item['serialize_s'] = 0.0
-                item['publish_wait_s'] = 0.0
-                item['copies_before'] = getattr(serializer, 'copies', 0)
-                process_start = time.perf_counter()
-                try:
-                    worker.process(*args, **kwargs)
-                except Exception as e:
-                    send([b''], _WorkerError(e, traceback.format_exc()))
-                elapsed = time.perf_counter() - process_start
-                times = worker.drain_stage_times() \
-                    if hasattr(worker, 'drain_stage_times') else {}
-                transport = item['serialize_s'] + item['publish_wait_s']
-                times['serialize_s'] = times.get('serialize_s', 0.0) \
-                    + item['serialize_s']
-                times['worker_publish_wait_s'] = \
-                    times.get('worker_publish_wait_s', 0.0) + item['publish_wait_s']
-                finalize_item_times(times, elapsed, transport_s=transport)
-                send([b''], VentilatedItemProcessedMessage(stats={
-                    'times': times,
-                    'payload_copies': getattr(serializer, 'copies', 0)
-                    - item['copies_before'],
-                }))
+                lookahead = getattr(worker, 'prefetch_lookahead', 0)
+                while len(pending) - 1 < lookahead:
+                    try:
+                        pending.append(
+                            work_receiver.recv_pyobj(zmq.NOBLOCK))
+                    except zmq.Again:
+                        break
+            if not pending:
+                continue
+            if hint is not None:
+                # whole pending FIFO, head included (the readahead treats its
+                # outstanding reads as a prefix of this list)
+                hint(list(pending))
+            args, kwargs = pending.popleft()
+            item['serialize_s'] = 0.0
+            item['publish_wait_s'] = 0.0
+            item['copies_before'] = getattr(serializer, 'copies', 0)
+            process_start = time.perf_counter()
+            try:
+                worker.process(*args, **kwargs)
+            except Exception as e:
+                send([b''], _WorkerError(e, traceback.format_exc()))
+            elapsed = time.perf_counter() - process_start
+            times = worker.drain_stage_times() \
+                if hasattr(worker, 'drain_stage_times') else {}
+            transport = item['serialize_s'] + item['publish_wait_s']
+            times['serialize_s'] = times.get('serialize_s', 0.0) \
+                + item['serialize_s']
+            times['worker_publish_wait_s'] = \
+                times.get('worker_publish_wait_s', 0.0) + item['publish_wait_s']
+            finalize_item_times(times, elapsed, transport_s=transport)
+            item_stats = {
+                'times': times,
+                'payload_copies': getattr(serializer, 'copies', 0)
+                - item['copies_before'],
+            }
+            if hasattr(worker, 'drain_stat_counts'):
+                counts, gauges = worker.drain_stat_counts()
+                if counts:
+                    item_stats['counts'] = counts
+                if gauges:
+                    item_stats['gauges'] = gauges
+            send([b''], VentilatedItemProcessedMessage(stats=item_stats))
     finally:
         worker.shutdown()
         send([b''], _WorkerTerminated(worker_id))

@@ -23,7 +23,13 @@ from contextlib import contextmanager
 
 #: Wall-time stages, in pipeline order. All are seconds.
 TIME_STAGES = (
-    'worker_io_s',       # parquet row-group read inside the worker
+    'worker_io_s',       # storage stall inside the worker (inline reads +
+                         # time blocked waiting on an unfinished prefetch)
+    'readahead_io_s',    # parquet reads issued by the background readahead
+                         # thread (overlaps worker_decode_s by construction)
+    'readahead_wait_s',  # worker blocked on a prefetched-but-unfinished read
+                         # (the un-hidden part of readahead_io_s; also
+                         # counted in worker_io_s)
     'worker_decode_s',   # codec decode / transform inside the worker
     'worker_publish_wait_s',  # worker blocked on a full results queue
     'serialize_s',       # payload -> transport frames (process pools)
@@ -38,10 +44,15 @@ COUNTERS = (
     'payload_copies',    # full-payload memcpys made by the transport
     'payload_frames',    # transport frames shipped (multipart parts)
     'items_out',         # results delivered to the consumer
+    'readahead_hits',    # row-group reads served from the prefetch queue
+    'readahead_misses',  # row-group reads that went inline (not prefetched)
 )
 
 #: Occupancy gauges; each also keeps a ``<name>_max`` high-water mark.
-GAUGES = ('queue_depth', 'shuffle_buffer_depth')
+GAUGES = ('queue_depth', 'shuffle_buffer_depth', 'readahead_depth')
+
+#: Derived keys added to every snapshot (not accumulated directly).
+DERIVED = ('io_overlap_fraction',)
 
 
 class ReaderStats:
@@ -76,6 +87,22 @@ class ReaderStats:
         with self._lock:
             self._counts[counter] = self._counts.get(counter, 0) + n
 
+    def merge_counts(self, counters) -> None:
+        """Accumulate a ``{counter: n}`` mapping (shipped back from a process
+        worker)."""
+        if not counters:
+            return
+        with self._lock:
+            for name, n in counters.items():
+                self._counts[name] = self._counts.get(name, 0) + n
+
+    def merge_gauges(self, gauges) -> None:
+        """Apply a ``{gauge: value}`` mapping of fresh samples."""
+        if not gauges:
+            return
+        for name, value in gauges.items():
+            self.gauge(name, value)
+
     def gauge(self, name: str, value) -> None:
         with self._lock:
             self._gauges[name] = value
@@ -92,11 +119,18 @@ class ReaderStats:
             self.add_time(stage, time.perf_counter() - start)
 
     def snapshot(self) -> dict:
-        """One flat dict of every stage/counter/gauge (stable key set)."""
+        """One flat dict of every stage/counter/gauge (stable key set), plus
+        the derived ``io_overlap_fraction``: the share of readahead read time
+        hidden behind decode (``1 - readahead_wait_s / readahead_io_s``; 0.0
+        when readahead is off)."""
         with self._lock:
             out = dict(self._times)
             out.update(self._counts)
             out.update(self._gauges)
+        ra_io = out.get('readahead_io_s', 0.0)
+        ra_wait = out.get('readahead_wait_s', 0.0)
+        out['io_overlap_fraction'] = (
+            max(0.0, 1.0 - ra_wait / ra_io) if ra_io > 0 else 0.0)
         return out
 
 
@@ -117,4 +151,39 @@ def stage_keys() -> tuple:
     keys = list(TIME_STAGES) + list(COUNTERS)
     for name in GAUGES:
         keys.extend((name, name + '_max'))
+    keys.extend(DERIVED)
     return tuple(keys)
+
+
+def effective_io_s(snapshot: dict) -> float:
+    """Total storage-read seconds in a snapshot: inline stall plus background
+    readahead reads, minus the blocked wait that is counted in both
+    ``worker_io_s`` and ``readahead_io_s``. The one definition every io:decode
+    consumer (``recommend_io_readahead``, ``jax_utils.infeed_diagnosis``)
+    shares."""
+    return (snapshot.get('worker_io_s', 0.0)
+            + snapshot.get('readahead_io_s', 0.0)
+            - snapshot.get('readahead_wait_s', 0.0))
+
+
+def readahead_hit_rate(snapshot: dict) -> float:
+    """Fraction of row-group reads served from the prefetch queue."""
+    hits = snapshot.get('readahead_hits', 0)
+    return hits / max(1, hits + snapshot.get('readahead_misses', 0))
+
+
+def recommend_io_readahead(snapshot: dict, max_depth: int = 8) -> int:
+    """Suggested ``io_readahead`` depth from a :meth:`ReaderStats.snapshot`.
+
+    The worker-side ``depth='auto'`` controller applies the same formula to
+    its live local measurements; this consumer-side variant lets users tune a
+    fixed depth from ``reader.diagnostics`` after a profiling run. Effective
+    read time (:func:`effective_io_s`) over decode time is the io:decode
+    ratio; a pipeline needs roughly ``ceil(io / decode)`` reads in flight to
+    keep decode fed."""
+    import math
+    io_s = effective_io_s(snapshot)
+    decode_s = snapshot.get('worker_decode_s', 0.0)
+    if io_s <= 0 or decode_s <= 0:
+        return 1
+    return int(min(max_depth, max(1, math.ceil(io_s / decode_s))))

@@ -20,6 +20,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 from typing import Optional
 
 from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
@@ -53,12 +54,43 @@ class WorkerThread(threading.Thread):
         if self._profiler:
             self._profiler.enable()
         stats = self._pool.stats
+        # Readahead lookahead: a worker that exposes prefetch_lookahead > 0
+        # pops up to that many EXTRA items from the shared work queue and is
+        # hinted about them before processing the head — its background
+        # reader overlaps the next pieces' parquet reads with the current
+        # decode. The pending deque stays strictly FIFO, so single-worker
+        # readers keep ventilated-piece order.
+        pending = deque()
+        hint = getattr(self._worker, 'prefetch_hint', None)
         try:
             while True:
-                item = self._pool._work_queue.get()
-                if item is _SENTINEL:
+                if not pending:
+                    item = self._pool._work_queue.get()
+                    if item is _SENTINEL:
+                        break
+                    pending.append(item)
+                lookahead = getattr(self._worker, 'prefetch_lookahead', 0)
+                saw_sentinel = False
+                while lookahead and len(pending) - 1 < lookahead:
+                    try:
+                        extra = self._pool._work_queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if extra is _SENTINEL:
+                        saw_sentinel = True
+                        break
+                    pending.append(extra)
+                if saw_sentinel:
+                    # pool is stopping: drop un-processed lookahead items
+                    # (same fate as items left on the shared queue)
                     break
-                args, kwargs = item
+                if hint is not None:
+                    # hint the WHOLE pending FIFO (head included): the
+                    # readahead matches outstanding prefetches as a prefix of
+                    # this list, and the head's not-yet-consumed read is
+                    # usually the front of that prefix
+                    hint(list(pending))
+                args, kwargs = pending.popleft()
                 wait_before = self._publish_wait['s']
                 start = time.perf_counter()
                 try:
@@ -75,6 +107,10 @@ class WorkerThread(threading.Thread):
                     times.get('worker_publish_wait_s', 0.0) + publish_wait
                 stats.merge_times(finalize_item_times(times, elapsed,
                                                       transport_s=publish_wait))
+                if hasattr(self._worker, 'drain_stat_counts'):
+                    counts, gauges = self._worker.drain_stat_counts()
+                    stats.merge_counts(counts)
+                    stats.merge_gauges(gauges)
                 self._pool._put_result(VentilatedItemProcessedMessage())
         finally:
             if self._profiler:
@@ -85,6 +121,10 @@ class WorkerThread(threading.Thread):
 
 class ThreadPool:
     """Thread-based pool implementing the ventilate/get_results protocol."""
+
+    #: The worker loop passes upcoming items to ``worker.prefetch_hint`` —
+    #: readers may enable ``io_readahead`` on this pool.
+    supports_prefetch_hints = True
 
     def __init__(self, workers_count: int, results_queue_size: int = _RESULTS_QUEUE_SIZE_DEFAULT,
                  profiling_enabled: bool = False):

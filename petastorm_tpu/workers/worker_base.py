@@ -16,6 +16,11 @@ class WorkerBase(ABC):
         #: straight into ``pool.stats``, process pools ship it back in the
         #: accounting control message).
         self.stage_times = {}
+        #: Monotonic counters / last-value gauges accumulated since the last
+        #: drain (e.g. readahead hit/miss, prefetch-queue occupancy); same
+        #: drain discipline as :attr:`stage_times`.
+        self.stat_counts = {}
+        self.stat_gauges = {}
 
     @abstractmethod
     def process(self, *args, **kwargs):
@@ -27,10 +32,25 @@ class WorkerBase(ABC):
         (see :mod:`petastorm_tpu.workers.stats` for the stage names)."""
         self.stage_times[stage] = self.stage_times.get(stage, 0.0) + seconds
 
+    def record_count(self, name: str, n: int = 1) -> None:
+        """Accumulate ``n`` against a ``ReaderStats`` counter."""
+        self.stat_counts[name] = self.stat_counts.get(name, 0) + n
+
+    def record_gauge(self, name: str, value) -> None:
+        """Sample a ``ReaderStats`` gauge (last value wins within one item)."""
+        self.stat_gauges[name] = value
+
     def drain_stage_times(self) -> dict:
         """Return and reset the accumulated per-stage times."""
         times, self.stage_times = self.stage_times, {}
         return times
+
+    def drain_stat_counts(self):
+        """Return and reset ``(counters, gauges)`` accumulated since the last
+        drain."""
+        counts, self.stat_counts = self.stat_counts, {}
+        gauges, self.stat_gauges = self.stat_gauges, {}
+        return counts, gauges
 
     def shutdown(self):
         """Optional cleanup hook invoked when the pool stops."""

@@ -42,6 +42,75 @@ class TestReaderStatsUnit:
         assert snap['worker_io_s'] == 1.5
         assert snap['serialize_s'] == 0.5
 
+    def test_merge_counts_and_gauges(self):
+        stats = ReaderStats()
+        stats.merge_counts({'readahead_hits': 3, 'readahead_misses': 1})
+        stats.merge_counts({'readahead_hits': 2})
+        stats.merge_gauges({'readahead_depth': 4})
+        stats.merge_gauges({'readahead_depth': 1})
+        snap = stats.snapshot()
+        assert snap['readahead_hits'] == 5
+        assert snap['readahead_misses'] == 1
+        assert snap['readahead_depth'] == 1
+        assert snap['readahead_depth_max'] == 4
+
+    def test_io_overlap_fraction_derivation(self):
+        stats = ReaderStats()
+        assert stats.snapshot()['io_overlap_fraction'] == 0.0
+        stats.add_time('readahead_io_s', 4.0)
+        stats.add_time('readahead_wait_s', 1.0)
+        assert stats.snapshot()['io_overlap_fraction'] == pytest.approx(0.75)
+
+    def test_snapshot_consistency_under_concurrent_updates(self):
+        """Writers from many threads (the thread-pool shape: workers merging
+        per-item times, the consumer adding counters, pools sampling gauges)
+        must never corrupt a concurrent snapshot: every snapshot sees
+        non-decreasing counters and the stable key set, and the final totals
+        are exact — no update lost."""
+        import threading
+
+        stats = ReaderStats()
+        writers = 6
+        iterations = 300
+        start_barrier = threading.Barrier(writers + 1)
+
+        def writer(worker_id):
+            start_barrier.wait()
+            for i in range(iterations):
+                stats.merge_times({'worker_io_s': 0.001,
+                                   'worker_decode_s': 0.002})
+                stats.add('items_out')
+                stats.merge_counts({'readahead_hits': 1})
+                stats.gauge('queue_depth', (worker_id * iterations + i) % 17)
+
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(writers)]
+        for t in threads:
+            t.start()
+        start_barrier.wait()
+        last_items = 0
+        snapshots = []
+        while any(t.is_alive() for t in threads):
+            snap = stats.snapshot()
+            snapshots.append(snap)
+            assert set(stage_keys()) <= set(snap)
+            assert snap['items_out'] >= last_items       # monotonic counter
+            last_items = snap['items_out']
+            # a torn read would break the 1:2 io:decode invariant wildly;
+            # both sides accumulate under one lock, but each merge applies
+            # both stages atomically so the ratio can lag at most one update
+            assert snap['worker_decode_s'] >= snap['worker_io_s']
+        for t in threads:
+            t.join()
+        final = stats.snapshot()
+        total = writers * iterations
+        assert final['items_out'] == total
+        assert final['readahead_hits'] == total
+        assert final['worker_io_s'] == pytest.approx(0.001 * total)
+        assert final['worker_decode_s'] == pytest.approx(0.002 * total)
+        assert final['queue_depth_max'] == 16
+        assert snapshots, 'no concurrent snapshot was taken'
+
 
 def _consume_and_snapshot(reader):
     start = time.perf_counter()
@@ -97,6 +166,47 @@ class TestPoolDiagnostics:
             count, wall, diag = _consume_and_snapshot(reader)
         assert count > 0
         _assert_sane(diag, wall, workers=2, expect_transport=True)
+
+    @pytest.mark.parametrize('pool_type,workers', [('thread', 3),
+                                                   ('process', 2)])
+    def test_snapshot_consistent_while_pool_runs(self, synthetic_dataset,
+                                                 pool_type, workers):
+        """Snapshots taken concurrently with live pool updates (worker
+        threads / accounting messages from worker processes) must always
+        carry the stable key set and monotonic counters."""
+        import threading
+
+        seen = {'count': 0}
+        failures = []
+
+        def sampler(reader, stop_event):
+            last_items = 0
+            while not stop_event.is_set():
+                snap = reader.stats.snapshot()
+                seen['count'] += 1
+                if not set(stage_keys()) <= set(snap):
+                    failures.append('missing keys: {}'.format(
+                        set(stage_keys()) - set(snap)))
+                    return
+                if snap['items_out'] < last_items:
+                    failures.append('items_out went backwards')
+                    return
+                last_items = snap['items_out']
+
+        with make_columnar_reader(synthetic_dataset.url,
+                                  reader_pool_type=pool_type,
+                                  workers_count=workers, num_epochs=2,
+                                  io_readahead=2) as reader:
+            stop_event = threading.Event()
+            thread = threading.Thread(target=sampler,
+                                      args=(reader, stop_event))
+            thread.start()
+            count = sum(1 for _ in reader)
+            stop_event.set()
+            thread.join(timeout=10)
+        assert count > 0
+        assert seen['count'] > 0
+        assert not failures, failures
 
     def test_dummy_pool_stages(self, synthetic_dataset):
         with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
