@@ -121,6 +121,12 @@ def main():
     from petastorm_tpu.benchmark.readahead import run_readahead_bench
     readahead = run_readahead_bench(quick=True)
 
+    # -- tracing: span-tracer overhead (items/s on vs off) ------------------
+    # The quick mode is the smoke signal (sub-second passes are noisy); the
+    # defensible <5% figure lives in BENCH_r08.json from the full run.
+    from petastorm_tpu.benchmark.trace_overhead import run_trace_overhead_bench
+    trace_overhead = run_trace_overhead_bench(quick=True)
+
     # -- north-star: train-step infeed overlap ------------------------------
     # Accelerator-scale configs for any non-CPU backend; dataset paths carry
     # the size parameters so a platform change can't reuse a stale store.
@@ -296,6 +302,7 @@ def main():
         'dispersion': dispersion,
         'transport': transport,
         'readahead': readahead,
+        'trace_overhead': trace_overhead,
         'northstar': {
             'platform': platform,
             'mnist_train': mnist.as_dict(),

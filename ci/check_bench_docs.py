@@ -42,8 +42,8 @@ ANNOTATION = re.compile(
 #: documents under the gate; every measured number they display must be
 #: annotated (MIN_ANNOTATIONS guards against the gate being emptied out)
 DEFAULT_DOCS = ('docs/benchmarks.md', 'docs/transport.md',
-                'docs/readahead.md')
-MIN_ANNOTATIONS = 25
+                'docs/readahead.md', 'docs/tracing.md')
+MIN_ANNOTATIONS = 30
 
 
 def _lookup(blob, keypath: str):

@@ -34,6 +34,9 @@ esac
 echo '== readahead quick bench (serial vs prefetched row-group reads) =='
 python -m petastorm_tpu.benchmark.readahead --quick
 
+echo '== trace-overhead quick bench (span tracer on vs off) =='
+python -m petastorm_tpu.benchmark.trace_overhead --quick
+
 echo '== bench-docs consistency gate =='
 python ci/check_bench_docs.py
 

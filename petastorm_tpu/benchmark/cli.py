@@ -42,6 +42,18 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument('-d', '--diagnostics', action='store_true',
                         help='Print the per-stage pipeline telemetry '
                              '(Reader.diagnostics) of the median run')
+    parser.add_argument('--trace', metavar='PATH', default=None,
+                        help='Record per-item pipeline spans and export a '
+                             'Chrome trace-event JSON (open in Perfetto / '
+                             'chrome://tracing) covering the measured window '
+                             'to PATH; with -r, each run overwrites it, so '
+                             'the last run wins (see docs/tracing.md)')
+    parser.add_argument('--metrics-interval', type=float, default=0,
+                        help='Snapshot reader stats every N seconds into '
+                             '--metrics-out while the benchmark runs')
+    parser.add_argument('--metrics-out', metavar='PATH', default=None,
+                        help='Metrics emitter output: JSON-lines, or '
+                             'Prometheus text exposition for .prom paths')
     parser.add_argument('-v', action='store_true', help='INFO logging')
     return parser
 
@@ -52,6 +64,8 @@ def main(argv=None) -> int:
         logging.basicConfig(level=logging.INFO)
     io_readahead = (args.io_readahead if args.io_readahead == 'auto'
                     else int(args.io_readahead))
+    if args.metrics_interval and not args.metrics_out:
+        raise SystemExit('--metrics-interval needs --metrics-out PATH')
     results = [reader_throughput(
         args.dataset_url, field_regex=args.field_regex,
         warmup_cycles=args.warmup_cycles, measure_cycles=args.measure_cycles,
@@ -59,7 +73,9 @@ def main(argv=None) -> int:
         shuffling_queue_size=args.shuffling_queue_size,
         read_method=args.read_method, batch_reader=args.batch_reader,
         jax_batch_size=args.jax_batch_size,
-        io_readahead=io_readahead) for _ in range(max(1, args.runs))]
+        io_readahead=io_readahead, trace_path=args.trace,
+        metrics_interval=args.metrics_interval,
+        metrics_out=args.metrics_out) for _ in range(max(1, args.runs))]
     # headline = median run: the honest central figure (best would overstate)
     by_rate = sorted(results, key=lambda r: r.samples_per_sec)
     result = by_rate[len(by_rate) // 2]
@@ -78,6 +94,9 @@ def main(argv=None) -> int:
         print('Pipeline telemetry (median run): {}'.format(
             json.dumps({k: (round(v, 4) if isinstance(v, float) else v)
                         for k, v in sorted(result.diagnostics.items())})))
+    if args.trace:
+        print('Chrome trace written to {} (open in https://ui.perfetto.dev)'
+              .format(args.trace))
     return 0
 
 

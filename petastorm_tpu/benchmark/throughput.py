@@ -22,9 +22,11 @@ class ThroughputResult:
     measure_cycles: int
     rss_mb: float
     cpu_percent: float
-    #: ``Reader.diagnostics`` snapshot taken right after the measured window:
-    #: per-stage wall times (worker io/decode, serialize/deserialize, queue
-    #: wait), payload bytes/copies, and queue-occupancy gauges.
+    #: ``Reader.diagnostics`` snapshot taken right after the measured window.
+    #: Stats are reset after warmup, so the per-stage wall times (worker
+    #: io/decode, serialize/deserialize, queue wait), payload bytes/copies,
+    #: gauges and derived ``items_per_s``/``mb_per_s`` cover the measured
+    #: samples only.
     diagnostics: Optional[dict] = None
 
 
@@ -52,18 +54,30 @@ def reader_throughput(dataset_url: str,
                       read_method: str = 'python',
                       batch_reader: bool = False,
                       jax_batch_size: int = 0,
-                      io_readahead=0) -> ThroughputResult:
+                      io_readahead=0,
+                      trace=None,
+                      trace_path: Optional[str] = None,
+                      metrics_interval: float = 0,
+                      metrics_out: Optional[str] = None) -> ThroughputResult:
     """Measure reader throughput on ``dataset_url``.
 
     ``read_method='python'`` iterates raw reader rows/batches;
     ``read_method='jax'`` wraps the reader in :class:`JaxDataLoader` with
     ``jax_batch_size`` and counts device-batch rows.
+
+    ``trace_path`` enables per-item span tracing and exports the chrome
+    trace of the measured window (warmup spans are dropped) there;
+    ``metrics_interval``/``metrics_out`` run the continuous metrics emitter
+    alongside the measurement.
     """
     import psutil
 
     factory = make_batch_reader if batch_reader else make_reader
+    if trace_path is not None and trace is None:
+        trace = True
     kwargs = dict(reader_pool_type=pool_type, workers_count=workers_count,
-                  num_epochs=None, io_readahead=io_readahead)
+                  num_epochs=None, io_readahead=io_readahead, trace=trace,
+                  metrics_interval=metrics_interval, metrics_out=metrics_out)
     if field_regex is not None:
         kwargs['schema_fields'] = field_regex
 
@@ -82,6 +96,13 @@ def reader_throughput(dataset_url: str,
             raise ValueError('Unknown read_method {!r}'.format(read_method))
 
         _consume(iterator, warmup_cycles, batched)
+        # warmup decode/io must not pollute the measured window: the stage
+        # times, counters and derived items_per_s/mb_per_s in `diagnostics`
+        # cover exactly the measured samples (the trace window likewise)
+        if reader.stats is not None:
+            reader.stats.reset()
+        if reader.tracer is not None:
+            reader.tracer.reset()
         proc.cpu_percent()  # reset the cpu counter window
         start = time.perf_counter()
         actual = _consume(iterator, measure_cycles, batched)
@@ -89,6 +110,8 @@ def reader_throughput(dataset_url: str,
         cpu = proc.cpu_percent()
         rss = proc.memory_info().rss / (1024.0 * 1024.0)
         diagnostics = reader.diagnostics
+        if trace_path is not None and reader.tracer is not None:
+            reader.tracer.export_chrome_trace(trace_path)
 
     return ThroughputResult(samples_per_sec=actual / elapsed,
                             warmup_cycles=warmup_cycles,

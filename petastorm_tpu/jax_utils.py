@@ -220,6 +220,12 @@ class JaxLoaderBase(object):
         self.reader = reader
         self._in_iter = None
         self._error = None
+        #: The reader pool's :class:`~petastorm_tpu.tracing.Tracer` (None
+        #: when tracing is off). The iteration loop records ``infeed_wait``
+        #: (time producing the next batch) and ``train_step`` (the consumer's
+        #: gap between batches) spans into it, so the device-idle gap is
+        #: visible on the same timeline as the worker stages.
+        self.tracer = getattr(reader, 'tracer', None)
 
     def __iter__(self):
         if self._error is not None:
@@ -232,9 +238,28 @@ class JaxLoaderBase(object):
             logger.warning('Start a new pass of the Reader. To avoid I/O, consider '
                            'in-memory caching (inmemory_cache_all=True).')
         self._in_iter = True
+        tracer = self.tracer
         try:
-            for batch in self._iter_impl():
-                yield batch
+            if tracer is None:
+                for batch in self._iter_impl():
+                    yield batch
+            else:
+                it = self._iter_impl()
+                while True:
+                    fetch_start = time.perf_counter()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    now = time.perf_counter()
+                    tracer.add_span('infeed_wait', 'consumer', fetch_start,
+                                    now - fetch_start)
+                    step_start = time.perf_counter()
+                    yield batch
+                    # the time the consumer held the generator suspended IS
+                    # its train step (plus any device sync inside it)
+                    tracer.add_span('train_step', 'consumer', step_start,
+                                    time.perf_counter() - step_start)
         except Exception as e:
             self._error = e
             raise
@@ -580,12 +605,14 @@ class ShardedJaxLoader(JaxLoaderBase):
             elif batch is None:
                 return
             stats = self._loader.stats
+            tracer = self.tracer
             if self._ngram is not None:
                 yield {off: stage_to_global(cols, self._named_sharding,
-                                            stats=stats)
+                                            stats=stats, tracer=tracer)
                        for off, cols in batch.items()}
             else:
-                yield stage_to_global(batch, self._named_sharding, stats=stats)
+                yield stage_to_global(batch, self._named_sharding, stats=stats,
+                                      tracer=tracer)
 
 
 def _all_processes_ready(local_ready: bool) -> bool:
@@ -599,14 +626,17 @@ def _all_processes_ready(local_ready: bool) -> bool:
     return bool(np.asarray(flags).min())
 
 
-def stage_to_global(batch, named_sharding, stats=None):
+def stage_to_global(batch, named_sharding, stats=None, tracer=None):
     """Assemble a host batch dict into global ``jax.Array``s over
     ``named_sharding``; device-incompatible (string/object) columns ride
     under ``batch['_host']`` untouched — the single definition of the
     'what can live in HBM' split. ``stats`` (a ``ReaderStats``) accumulates
-    the assembly wall time as ``device_stage_s``."""
+    the assembly wall time as ``device_stage_s``; ``tracer`` (a
+    :class:`~petastorm_tpu.tracing.Tracer`) records it as a ``device_stage``
+    span."""
     import jax
-    start = time.perf_counter() if stats is not None else 0.0
+    timed = stats is not None or tracer is not None
+    start = time.perf_counter() if timed else 0.0
     device, host = {}, {}
     for name, value in batch.items():
         if _is_device_compatible(value):
@@ -616,8 +646,12 @@ def stage_to_global(batch, named_sharding, stats=None):
             host[name] = value
     if host:
         device['_host'] = host
-    if stats is not None:
-        stats.add_time('device_stage_s', time.perf_counter() - start)
+    if timed:
+        elapsed = time.perf_counter() - start
+        if stats is not None:
+            stats.add_time('device_stage_s', elapsed)
+        if tracer is not None:
+            tracer.add_span('device_stage', 'device', start, elapsed)
     return device
 
 
@@ -747,7 +781,8 @@ def prefetch_batches(iterator, size=2):
     return _pipeline(iterator, size, lambda batch: batch)
 
 
-def prefetch_to_device(iterator, size=2, sharding=None, stats=None):
+def prefetch_to_device(iterator, size=2, sharding=None, stats=None,
+                       tracer=None):
     """Double-buffered host→device prefetch.
 
     Stages up to ``size`` batches ahead of the consumer on a background thread
@@ -762,6 +797,10 @@ def prefetch_to_device(iterator, size=2, sharding=None, stats=None):
     :param stats: optional ``ReaderStats`` (e.g. ``reader.stats`` /
         ``loader.stats``) accumulating the transfer-dispatch wall time as
         ``device_stage_s``.
+    :param tracer: optional ``Tracer`` (e.g. ``reader.tracer``) recording
+        each transfer dispatch as a ``device_stage`` span — the prefetch
+        thread gets its own track, so the overlap with the consumer's
+        ``train_step`` spans is visible directly.
     """
     import jax
 
@@ -769,7 +808,8 @@ def prefetch_to_device(iterator, size=2, sharding=None, stats=None):
         # _is_device_compatible reads dtype via getattr: global jax.Arrays must
         # NOT be round-tripped through np.asarray (device->host copy; crashes
         # on non-fully-addressable multi-host arrays).
-        start = time.perf_counter() if stats is not None else 0.0
+        timed = stats is not None or tracer is not None
+        start = time.perf_counter() if timed else 0.0
         if sharding is None:
             staged = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x) if _is_device_compatible(x) else x,
@@ -778,8 +818,12 @@ def prefetch_to_device(iterator, size=2, sharding=None, stats=None):
             staged = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, sharding) if _is_device_compatible(x) else x,
                 batch)
-        if stats is not None:
-            stats.add_time('device_stage_s', time.perf_counter() - start)
+        if timed:
+            elapsed = time.perf_counter() - start
+            if stats is not None:
+                stats.add_time('device_stage_s', elapsed)
+            if tracer is not None:
+                tracer.add_span('device_stage', 'device', start, elapsed)
         return staged
 
     return _pipeline(iterator, size, put)

@@ -35,6 +35,7 @@ from petastorm_tpu.predicates import in_reduce
 from petastorm_tpu.readers.batch_worker import ArrowBatchWorker, BatchResultsReader
 from petastorm_tpu.readers.columnar_worker import ColumnarResultsReader, ColumnarWorker
 from petastorm_tpu.readers.row_worker import RowGroupResultsReader, RowGroupWorker
+from petastorm_tpu.tracing import MetricsEmitter, Tracer, resolve_trace
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.unischema import match_unischema_fields
 from petastorm_tpu.utils import cast_partition_value
@@ -81,17 +82,24 @@ def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_est
 
 
 def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer,
-               zmq_copy_buffers, profiling_enabled=False):
+               zmq_copy_buffers, profiling_enabled=False, tracer=None):
     if reader_pool_type == 'thread':
         return ThreadPool(workers_count, results_queue_size,
-                          profiling_enabled=profiling_enabled)
+                          profiling_enabled=profiling_enabled, tracer=tracer)
     if reader_pool_type == 'process':
         return ProcessPool(workers_count, serializer=serializer,
-                           zmq_copy_buffers=zmq_copy_buffers)
+                           zmq_copy_buffers=zmq_copy_buffers, tracer=tracer)
     if reader_pool_type == 'dummy':
-        return DummyPool()
+        return DummyPool(tracer=tracer)
     raise ValueError("reader_pool_type must be one of 'thread', 'process', 'dummy'; "
                      'got {!r}'.format(reader_pool_type))
+
+
+def _make_tracer(trace):
+    """Resolve the ``trace=`` kwarg (and :data:`~petastorm_tpu.tracing.TRACE_ENV_VAR`)
+    into ``(Tracer-or-None, export_path-or-None)``."""
+    enabled, export_path = resolve_trace(trace)
+    return (Tracer() if enabled else None), export_path
 
 
 def _relax_hinted_shapes(schema, decode_hints, stored_schema):
@@ -140,7 +148,8 @@ def make_reader(dataset_url,
                 transform_spec=None, filters=None,
                 storage_options=None, zmq_copy_buffers=True,
                 profiling_enabled=False, decode_hints=None,
-                io_readahead=0):
+                io_readahead=0, trace=None, metrics_interval=0,
+                metrics_out=None):
     """Row-granular reader for petastorm_tpu datasets (codec-decoded rows).
 
     Mirrors the reference factory (``reader.py:61-195``). Raises a helpful error
@@ -157,6 +166,13 @@ def make_reader(dataset_url,
     the parquet reads of its next K ventilated pieces while it decodes the
     current one, overlapping storage latency with decode CPU; ``'auto'``
     sizes K from the live io:decode ratio (see ``docs/readahead.md``).
+
+    ``trace=True`` (or the ``PETASTORM_TPU_TRACE`` env var) records per-item
+    spans for every pipeline stage into ``reader.tracer``, exportable as
+    Chrome trace-event JSON for Perfetto; ``metrics_interval=N`` starts a
+    background emitter snapshotting the reader's stats every N seconds into
+    ``metrics_out`` (JSON-lines, or Prometheus text for ``.prom`` paths).
+    See ``docs/tracing.md``.
     """
     dataset_url = normalize_dataset_url_or_urls(dataset_url)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url, storage_options)
@@ -172,10 +188,12 @@ def make_reader(dataset_url,
 
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings)
+    tracer, trace_export = _make_tracer(trace)
     # ZeroCopySerializer: decoded ndarray payloads cross the process boundary
     # as out-of-band ZMQ frames instead of being memcpy'd into a pickle blob
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      ZeroCopySerializer(), zmq_copy_buffers, profiling_enabled)
+                      ZeroCopySerializer(), zmq_copy_buffers, profiling_enabled,
+                      tracer=tracer)
     cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
     return Reader(factory, path,
                   worker_class=RowGroupWorker,
@@ -187,7 +205,8 @@ def make_reader(dataset_url,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec, filters=filters,
                   pool=pool, is_batched_reader=False, decode_hints=decode_hints,
-                  io_readahead=io_readahead)
+                  io_readahead=io_readahead, trace_export=trace_export,
+                  metrics_interval=metrics_interval, metrics_out=metrics_out)
 
 
 def make_columnar_reader(dataset_url,
@@ -204,7 +223,8 @@ def make_columnar_reader(dataset_url,
                          transform_spec=None, filters=None,
                          storage_options=None, zmq_copy_buffers=True,
                          profiling_enabled=False, decode_hints=None,
-                         io_readahead=0):
+                         io_readahead=0, trace=None, metrics_interval=0,
+                         metrics_out=None):
     """Vectorized codec-decoded reader for petastorm_tpu datasets.
 
     Yields **batch namedtuples of decoded numpy column arrays** (one per row
@@ -237,8 +257,10 @@ def make_columnar_reader(dataset_url,
 
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings)
+    tracer, trace_export = _make_tracer(trace)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      ZeroCopySerializer(), zmq_copy_buffers, profiling_enabled)
+                      ZeroCopySerializer(), zmq_copy_buffers, profiling_enabled,
+                      tracer=tracer)
     cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
     return Reader(factory, path,
                   worker_class=ColumnarWorker,
@@ -250,7 +272,8 @@ def make_columnar_reader(dataset_url,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec, filters=filters,
                   pool=pool, is_batched_reader=True, decode_hints=decode_hints,
-                  io_readahead=io_readahead)
+                  io_readahead=io_readahead, trace_export=trace_export,
+                  metrics_interval=metrics_interval, metrics_out=metrics_out)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -264,11 +287,13 @@ def make_batch_reader(dataset_url_or_urls,
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None, filters=None,
                       storage_options=None, zmq_copy_buffers=True,
-                      profiling_enabled=False, io_readahead=0):
+                      profiling_enabled=False, io_readahead=0, trace=None,
+                      metrics_interval=0, metrics_out=None):
     """Vectorized batch reader for arbitrary parquet stores
     (reference ``reader.py:198-327``). Yields namedtuples of column arrays,
     one per row group. ``io_readahead`` prefetches upcoming row-group reads
-    per worker (see :func:`make_reader`)."""
+    per worker; ``trace``/``metrics_interval``/``metrics_out`` enable the
+    span tracer and metrics emitter (see :func:`make_reader`)."""
     dataset_url_or_urls = normalize_dataset_url_or_urls(dataset_url_or_urls)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url_or_urls,
                                                          storage_options)
@@ -280,8 +305,10 @@ def make_batch_reader(dataset_url_or_urls,
                          'features)')
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings)
+    tracer, trace_export = _make_tracer(trace)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      ArrowTableSerializer(), zmq_copy_buffers, profiling_enabled)
+                      ArrowTableSerializer(), zmq_copy_buffers, profiling_enabled,
+                      tracer=tracer)
     cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
     return Reader(factory, path,
                   worker_class=ArrowBatchWorker,
@@ -291,7 +318,9 @@ def make_batch_reader(dataset_url_or_urls,
                   predicate=predicate, rowgroup_selector=None,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec, filters=filters,
-                  pool=pool, is_batched_reader=True, io_readahead=io_readahead)
+                  pool=pool, is_batched_reader=True, io_readahead=io_readahead,
+                  trace_export=trace_export, metrics_interval=metrics_interval,
+                  metrics_out=metrics_out)
 
 
 class Reader:
@@ -304,7 +333,8 @@ class Reader:
                  num_epochs=1, cur_shard=None, shard_count=None,
                  cache=None, transform_spec=None, filters=None,
                  pool=None, is_batched_reader=False, decode_hints=None,
-                 io_readahead=0):
+                 io_readahead=0, trace_export=None, metrics_interval=0,
+                 metrics_out=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -313,11 +343,16 @@ class Reader:
         if predicate is not None and not isinstance(cache, NullCache):
             raise RuntimeError('Local cache is not supported together with predicates '
                                '(cached row groups would bypass predicate evaluation)')
+        if metrics_interval and not metrics_out:
+            raise ValueError('metrics_interval needs a metrics_out path to '
+                             'emit snapshots into')
         self._filesystem_factory = filesystem_factory
         self._dataset_path = dataset_path
         self._pool = pool
         self._is_batched_reader = is_batched_reader
         self._num_epochs = num_epochs
+        self._trace_export = trace_export
+        self._metrics_emitter = None
         self.last_row_consumed = False
 
         filesystem = filesystem_factory()
@@ -415,13 +450,20 @@ class Reader:
                          else io_readahead)
         else:
             lookahead = 0
+        tracer = getattr(pool, 'tracer', None)
+        ventilate_fn = pool.ventilate
+        if tracer is not None:
+            def ventilate_fn(*v_args, **v_kwargs):
+                with tracer.span('ventilate', 'ventilator'):
+                    pool.ventilate(*v_args, **v_kwargs)
         self._ventilator = ConcurrentVentilator(
-            pool.ventilate, items, iterations=num_epochs,
+            ventilate_fn, items, iterations=num_epochs,
             randomize_item_order=shuffle_row_groups, random_seed=seed,
             max_ventilation_queue_size=(
                 pool.workers_count * (1 + lookahead) + _VENTILATE_EXTRA_ROWGROUPS))
 
         worker_args = {
+            'trace': tracer is not None,
             'filesystem_factory': filesystem_factory,
             'dataset_path': dataset_path,
             'schema': view_schema,
@@ -437,6 +479,10 @@ class Reader:
         # fail fast on bad hints (workers rebuild these after unpickling)
         build_decode_overrides(stored_schema, decode_hints)
         pool.start(worker_class, worker_args, self._ventilator)
+        if metrics_interval:
+            self._metrics_emitter = MetricsEmitter(
+                pool.stats.snapshot, metrics_interval, metrics_out)
+            self._metrics_emitter.start()
         self._results_reader = results_reader_factory(transformed_schema, self.ngram)
         self._stopped = False
         #: True when every published NGram item is a columnar
@@ -598,10 +644,22 @@ class Reader:
 
     def stop(self):
         self._stopped = True
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.stop(join=False)
         self._pool.stop()
 
     def join(self):
         self._pool.join()
+        if self._metrics_emitter is not None:
+            # joins the emitter thread and writes one final snapshot, so even
+            # sub-interval runs record at least one sample
+            self._metrics_emitter.stop()
+        if self._trace_export and self.tracer is not None:
+            try:
+                self.tracer.export_chrome_trace(self._trace_export)
+            except OSError:
+                logger.exception('Failed to export chrome trace to %s',
+                                 self._trace_export)
 
     def cleanup(self):
         pass
@@ -619,6 +677,14 @@ class Reader:
         the live per-stage telemetry accumulator. The JAX loaders record
         device staging time into it; ``diagnostics`` snapshots it."""
         return getattr(self._pool, 'stats', None)
+
+    @property
+    def tracer(self):
+        """The pool's :class:`~petastorm_tpu.tracing.Tracer` (``None`` unless
+        the reader was built with ``trace=``/``PETASTORM_TPU_TRACE``). Call
+        ``reader.tracer.export_chrome_trace(path)`` for a Perfetto-loadable
+        timeline; the JAX loaders record their spans into the same tracer."""
+        return getattr(self._pool, 'tracer', None)
 
     @property
     def diagnostics(self):

@@ -9,6 +9,7 @@ results-queue reader converting Table -> numpy dict (:38-87).
 
 from __future__ import annotations
 
+import time
 from typing import List
 
 import numpy as np
@@ -134,6 +135,14 @@ class ArrowBatchWorker(ParquetPieceWorker):
         """Run TransformSpec.func on a pandas frame; validate shapes and ravel
         >1-D ndarray cells since arrow has no ndarray columns
         (reference ``_check_shape_and_ravel``, :172-186)."""
+        start = time.perf_counter()
+        try:
+            return self._apply_transform_impl(table)
+        finally:
+            self.record_span('transform', 'decode', start,
+                             time.perf_counter() - start)
+
+    def _apply_transform_impl(self, table: pa.Table) -> pa.Table:
         spec = self._transform_spec
         df = table.to_pandas()
         if spec.func is not None:

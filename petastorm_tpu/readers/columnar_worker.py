@@ -14,6 +14,7 @@ path exists because the JAX adapter wants exactly this layout.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -369,5 +370,9 @@ class ColumnarWorker(ParquetPieceWorker):
         contract; the row path hands ``func`` one row dict at a time, the arrow
         batch path a pandas frame)."""
         from petastorm_tpu.transform import apply_columnar_transform
-        return apply_columnar_transform(self._transform_spec,
-                                        self._transformed_schema, columns)
+        start = time.perf_counter()
+        out = apply_columnar_transform(self._transform_spec,
+                                       self._transformed_schema, columns)
+        self.record_span('transform', 'decode', start,
+                         time.perf_counter() - start)
+        return out

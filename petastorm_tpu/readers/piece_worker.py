@@ -127,7 +127,8 @@ class ParquetPieceWorker(WorkerBase):
             # the background thread gets its own handle cache: a ParquetFile
             # must never serve two concurrent reads
             self._prefetch_files = FileHandleCache(self._open_parquet)
-            self._readahead = RowGroupReadahead(self._readahead_read, depth)
+            self._readahead = RowGroupReadahead(self._readahead_read, depth,
+                                                trace=self.tracing_enabled)
 
     def shutdown(self):
         if self._readahead is not None:
@@ -227,7 +228,10 @@ class ParquetPieceWorker(WorkerBase):
         start = time.perf_counter()
         table = self._parquet_file(piece.path).read_row_group(
             piece.row_group, columns=columns)
-        self.record_time('worker_io_s', time.perf_counter() - start)
+        elapsed = time.perf_counter() - start
+        self.record_time('worker_io_s', elapsed)
+        self.record_span('parquet_read', 'io', start, elapsed,
+                         args={'row_group': piece.row_group})
         return table
 
     def _decode_table(self, table, names) -> Dict:
@@ -235,6 +239,7 @@ class ParquetPieceWorker(WorkerBase):
         typed, honoring per-field decode overrides) — the one columnar decode
         shared by the columnar worker and the row worker's window path."""
         from petastorm_tpu.readers.columnar_worker import _column_to_numpy
+        start = time.perf_counter()
         out = {}
         for name in names:
             if name not in table.column_names:
@@ -242,6 +247,8 @@ class ParquetPieceWorker(WorkerBase):
             field = self._full_schema.fields[name]
             out[name] = _column_to_numpy(table.column(name), field,
                                          self._decode_overrides.get(name))
+        self.record_span('decode_columns', 'decode', start,
+                         time.perf_counter() - start)
         return out
 
     def _cache_key(self, prefix: str, piece) -> str:

@@ -75,9 +75,12 @@ class RowGroupReadahead:
     :param read_fn: ``read_fn(piece, columns) -> pa.Table``; runs **only** on
         the background thread (it must use its own file handles).
     :param depth: max outstanding prefetched reads, or ``'auto'``.
+    :param trace: record a ``readahead_read`` span per background read
+        (stamped with the background thread's track, drained into the worker
+        alongside the stats).
     """
 
-    def __init__(self, read_fn, depth):
+    def __init__(self, read_fn, depth, trace: bool = False):
         if depth != 'auto' and (not isinstance(depth, int) or depth < 1):
             raise ValueError(
                 "readahead depth must be a positive int or 'auto', got "
@@ -85,6 +88,7 @@ class RowGroupReadahead:
         self._read_fn = read_fn
         self._auto = depth == 'auto'
         self._depth = AUTO_INITIAL_DEPTH if self._auto else depth
+        self._trace = trace
         self._lock = threading.Lock()
         self._scheduled: deque = deque()      # FIFO of un-consumed _Prefetch
         self._requests: queue.Queue = queue.Queue()
@@ -93,6 +97,7 @@ class RowGroupReadahead:
         # accumulated telemetry, drained into the worker on its own thread
         self._stats_times = {'readahead_io_s': 0.0, 'readahead_wait_s': 0.0}
         self._stats_counts = {'readahead_hits': 0, 'readahead_misses': 0}
+        self._trace_spans: list = []
         # auto-depth measurement state (all mutated under self._lock)
         self._read_s_sum = 0.0
         self._read_samples = 0
@@ -199,6 +204,7 @@ class RowGroupReadahead:
                 self._stats_times[stage] = 0.0
             for name in self._stats_counts:
                 self._stats_counts[name] = 0
+            spans, self._trace_spans = self._trace_spans, []
             occupancy = len(self._scheduled)
         for stage, seconds in times.items():
             if seconds:
@@ -208,6 +214,9 @@ class RowGroupReadahead:
         for name, n in counts.items():
             if n:
                 worker.record_count(name, n)
+        if spans and getattr(worker, 'tracing_enabled', False):
+            # already stamped with the background thread's (pid, tid) track
+            worker.trace_spans.extend(spans)
         worker.record_gauge('readahead_depth', occupancy)
 
     # -- lifecycle -------------------------------------------------------------
@@ -249,6 +258,10 @@ class RowGroupReadahead:
             with self._lock:
                 if not entry.cancelled:
                     self._stats_times['readahead_io_s'] += entry.read_s
+                    if self._trace:
+                        from petastorm_tpu.tracing import make_span
+                        self._trace_spans.append(make_span(
+                            'readahead_read', 'io', start, entry.read_s))
                 self._read_s_sum += entry.read_s
                 self._read_samples += 1
                 self._retune_locked()

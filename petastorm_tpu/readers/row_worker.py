@@ -11,6 +11,7 @@ partitioning incl. ngram continuation rows (:260-273), results-queue reader
 from __future__ import annotations
 
 import threading
+import time
 from typing import List
 
 import numpy as np
@@ -212,6 +213,7 @@ class RowGroupWorker(ParquetPieceWorker):
         return self._read_row_group(piece, columns)
 
     def _decode_with_partitions(self, raw_rows: List[dict], piece, schema) -> List[dict]:
+        start = time.perf_counter()
         decoded = []
         partition_items = piece.partition_dict.items()
         for raw in raw_rows:
@@ -220,6 +222,8 @@ class RowGroupWorker(ParquetPieceWorker):
                 if field is not None:
                     raw[key] = _cast_partition_value(field, value)
             decoded.append(decode_row(raw, schema, self._decode_overrides))
+        self.record_span('decode_rows', 'decode', start,
+                         time.perf_counter() - start)
         return decoded
 
     def _load_rows(self, piece) -> List[dict]:

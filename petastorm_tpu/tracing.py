@@ -1,0 +1,290 @@
+"""Per-item pipeline tracing and continuous metrics emission.
+
+``ReaderStats`` (PR 1-2) answers *how much* time each stage consumed in
+aggregate; it cannot answer *which* item stalled *where*, or show how worker
+decode, transport, device staging and the jitted train step interleave in
+time. This module adds the span layer: a low-overhead, off-by-default
+:class:`Tracer` holding a bounded ring buffer of spans that every component
+on the sample path records into — ventilate, readahead, parquet read, decode,
+serialize, result-queue wait, deserialize, host batching, device staging, and
+the consumer's train step.
+
+Design constraints:
+
+- **Off by default, near-zero when off.** No ``Tracer`` object exists unless
+  tracing was requested (``trace=`` kwarg or ``PETASTORM_TPU_TRACE``); call
+  sites guard with ``if tracer is not None`` and workers behind a boolean, so
+  the disabled path adds one attribute test per site.
+- **Bounded memory.** Spans live in a ``deque(maxlen=capacity)``; long runs
+  keep the most recent window and count what they dropped
+  (:attr:`Tracer.dropped`) instead of growing without bound.
+- **One clock across processes.** Span timestamps are
+  ``time.perf_counter()`` values, which CPython maps to ``CLOCK_MONOTONIC``
+  on Linux — a system-wide clock, so spans recorded inside spawned worker
+  interpreters land on the same timeline as the consumer's without offset
+  arithmetic. Workers ship their span batches back inside the existing
+  per-item accounting control message (the ``merge_times`` pattern), each
+  span stamped with the recording ``(pid, tid)`` so Perfetto renders one
+  track per worker process/thread.
+- **Perfetto-ready output.** :meth:`Tracer.export_chrome_trace` writes the
+  Chrome trace-event JSON format (complete ``"ph": "X"`` events plus
+  process/thread-name metadata), loadable in https://ui.perfetto.dev or
+  ``chrome://tracing``.
+
+Spans are plain tuples ``(name, cat, start_s, dur_s, pid, tid, args)`` —
+cheap to record, cheap to pickle across the process-pool boundary.
+
+:class:`MetricsEmitter` is the counters-side companion: a background thread
+snapshotting a ``ReaderStats`` every N seconds to JSON-lines or Prometheus
+text-exposition format, so a training job's infeed health is scrapable
+without touching the training loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Callable, List, Optional, Tuple
+
+#: Environment variable controlling tracing when the ``trace=`` kwarg is left
+#: at its default. ``''``/``'0'``/``'false'``/``'off'`` disable;
+#: ``'1'``/``'true'``/``'on'`` enable; any other value enables tracing AND
+#: names the chrome-trace file exported when the reader joins.
+TRACE_ENV_VAR = 'PETASTORM_TPU_TRACE'
+
+#: Default ring-buffer bound: ~7 tuple slots per span keeps 100k spans in the
+#: low tens of MB while covering minutes of steady-state pipeline activity.
+DEFAULT_CAPACITY = 100_000
+
+#: A recorded span: (name, cat, start_s, dur_s, pid, tid, args-or-None).
+#: ``start_s`` is a ``time.perf_counter()`` reading; ``dur_s`` seconds.
+Span = Tuple[str, str, float, float, int, int, Optional[dict]]
+
+
+def resolve_trace(trace) -> Tuple[bool, Optional[str]]:
+    """Resolve a factory's ``trace=`` kwarg against :data:`TRACE_ENV_VAR`.
+
+    Returns ``(enabled, export_path)``. ``trace=None`` defers to the env var;
+    ``trace=True``/``False`` force; a string value enables tracing and names
+    the chrome-trace file auto-exported at ``Reader.join()``.
+    """
+    if trace is None:
+        value = os.environ.get(TRACE_ENV_VAR, '').strip()
+        if not value or value.lower() in ('0', 'false', 'off'):
+            return False, None
+        if value.lower() in ('1', 'true', 'on'):
+            return True, None
+        return True, value
+    if isinstance(trace, str):
+        return True, trace
+    return bool(trace), None
+
+
+def make_span(name: str, cat: str, start_s: float, dur_s: float,
+              pid: Optional[int] = None, tid: Optional[int] = None,
+              args: Optional[dict] = None) -> Span:
+    """Build one span tuple, stamping the calling thread/process when the
+    caller does not supply a track."""
+    return (name, cat, start_s, dur_s,
+            os.getpid() if pid is None else pid,
+            threading.get_ident() if tid is None else tid,
+            args)
+
+
+class Tracer:
+    """Thread-safe bounded ring buffer of pipeline spans.
+
+    One instance lives on the worker pool (``pool.tracer``, reachable as
+    ``reader.tracer`` / ``loader.tracer``) when tracing is enabled; thread
+    and dummy pools record into it directly, process workers accumulate spans
+    locally (``WorkerBase.record_span``) and the pool :meth:`merge`\\ s the
+    batches shipped back in the accounting message.
+    """
+
+    __slots__ = ('_lock', '_spans', '_added', '_origin_pid')
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError('capacity must be >= 1, got {}'.format(capacity))
+        self._lock = threading.Lock()
+        self._spans: 'deque[Span]' = deque(maxlen=capacity)
+        self._added = 0
+        # the constructing process is the consumer: its pid names the
+        # consumer track in the export metadata
+        self._origin_pid = os.getpid()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted by the ring bound since construction/reset."""
+        with self._lock:
+            return self._added - len(self._spans)
+
+    def add_span(self, name: str, cat: str, start_s: float, dur_s: float,
+                 pid: Optional[int] = None, tid: Optional[int] = None,
+                 args: Optional[dict] = None) -> None:
+        span = make_span(name, cat, start_s, dur_s, pid, tid, args)
+        with self._lock:
+            self._spans.append(span)
+            self._added += 1
+
+    @contextmanager
+    def span(self, name: str, cat: str = '', args: Optional[dict] = None):
+        """Record a complete span around the with-block."""
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_span(name, cat, start, time.perf_counter() - start,
+                          args=args)
+
+    def merge(self, spans) -> None:
+        """Append a batch of span tuples (shipped back from a worker)."""
+        if not spans:
+            return
+        with self._lock:
+            self._spans.extend(spans)
+            self._added += len(spans)
+
+    def reset(self) -> None:
+        """Drop every recorded span (benchmarks call this after warmup so the
+        exported window covers only the measured passes)."""
+        with self._lock:
+            self._spans.clear()
+            self._added = 0
+
+    def spans(self) -> List[Span]:
+        """A point-in-time copy of the buffered spans."""
+        with self._lock:
+            return list(self._spans)
+
+    # -- chrome trace-event export ---------------------------------------------
+
+    def chrome_trace_events(self) -> List[dict]:
+        """The buffered spans as Chrome trace-event dicts: complete events
+        (``ph='X'``, ``ts``/``dur`` in microseconds) sorted by timestamp,
+        preceded by ``process_name`` metadata naming the consumer vs worker
+        tracks."""
+        spans = self.spans()
+        spans.sort(key=lambda s: s[2])
+        events: List[dict] = []
+        for pid in sorted({s[4] for s in spans}):
+            role = 'consumer' if pid == self._origin_pid else 'worker'
+            events.append({'name': 'process_name', 'ph': 'M', 'pid': pid,
+                           'tid': 0,
+                           'args': {'name': 'petastorm_tpu {} (pid {})'
+                                    .format(role, pid)}})
+        for name, cat, start_s, dur_s, pid, tid, args in spans:
+            event = {'name': name, 'cat': cat or 'pipeline', 'ph': 'X',
+                     'ts': start_s * 1e6, 'dur': max(0.0, dur_s) * 1e6,
+                     'pid': pid, 'tid': tid}
+            if args:
+                event['args'] = args
+            events.append(event)
+        return events
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the buffered spans as Chrome trace-event JSON (open the file
+        in Perfetto / ``chrome://tracing``). Returns the number of span
+        events written."""
+        events = self.chrome_trace_events()
+        with open(path, 'w') as f:
+            json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+        return sum(1 for e in events if e['ph'] == 'X')
+
+
+class MetricsEmitter:
+    """Background thread snapshotting a stats source every ``interval_s``
+    seconds to a file.
+
+    Formats (picked from the path suffix unless ``fmt`` is given):
+
+    - ``jsonl`` — one JSON object per snapshot appended per line, with
+      ``ts`` (epoch seconds) added; tail it or ship it to a log pipeline.
+    - ``prometheus`` (``.prom`` suffix) — Prometheus text-exposition format,
+      atomically rewritten each snapshot; point a node-exporter textfile
+      collector at it.
+
+    A final snapshot is emitted at :meth:`stop` so short runs always record
+    at least one sample. ``Reader.stop()/join()`` drive the lifecycle.
+    """
+
+    def __init__(self, snapshot_fn: Callable[[], dict], interval_s: float,
+                 path: str, fmt: Optional[str] = None,
+                 prefix: str = 'petastorm_tpu'):
+        if interval_s <= 0:
+            raise ValueError('interval_s must be positive, got '
+                             '{!r}'.format(interval_s))
+        if fmt is None:
+            fmt = 'prometheus' if str(path).endswith('.prom') else 'jsonl'
+        if fmt not in ('jsonl', 'prometheus'):
+            raise ValueError("fmt must be 'jsonl' or 'prometheus', got "
+                             '{!r}'.format(fmt))
+        self._snapshot_fn = snapshot_fn
+        self._interval = interval_s
+        self._path = str(path)
+        self._fmt = fmt
+        self._prefix = prefix
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._emit_lock = threading.Lock()
+        self._final_emitted = False
+        self.emit_count = 0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name='petastorm-tpu-metrics')
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop_event.wait(self._interval):
+            self.emit_once()
+
+    def emit_once(self) -> None:
+        snapshot = dict(self._snapshot_fn())
+        with self._emit_lock:
+            if self._fmt == 'jsonl':
+                line = json.dumps({'ts': time.time(), **snapshot},
+                                  sort_keys=True)
+                with open(self._path, 'a') as f:
+                    f.write(line + '\n')
+            else:
+                self._write_prometheus(snapshot)
+            self.emit_count += 1
+
+    def _write_prometheus(self, snapshot: dict) -> None:
+        lines = []
+        for key in sorted(snapshot):
+            value = snapshot[key]
+            if not isinstance(value, (int, float)) or isinstance(value, bool):
+                continue
+            metric = '{}_{}'.format(self._prefix, key)
+            lines.append('# TYPE {} gauge'.format(metric))
+            lines.append('{} {}'.format(metric, float(value)))
+        tmp = '{}.tmp.{}'.format(self._path, os.getpid())
+        with open(tmp, 'w') as f:
+            f.write('\n'.join(lines) + '\n')
+        os.replace(tmp, self._path)
+
+    def stop(self, join: bool = True) -> None:
+        """Signal the thread to stop; with ``join`` (the default) also wait
+        for it and emit one final snapshot. Idempotent."""
+        self._stop_event.set()
+        if not join:
+            return
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=10)
+            self._thread = None
+        if not self._final_emitted:
+            self._final_emitted = True
+            self.emit_once()

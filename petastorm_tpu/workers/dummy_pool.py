@@ -15,12 +15,15 @@ from petastorm_tpu.workers.stats import ReaderStats, finalize_item_times
 
 
 class DummyPool:
-    def __init__(self, workers_count: int = 1, **_unused):
+    def __init__(self, workers_count: int = 1, tracer=None, **_unused):
         self._work_queue = deque()
         self._results_queue = deque()
         self._worker = None
         self._ventilator = None
         self.stats = ReaderStats()
+        #: Optional :class:`petastorm_tpu.tracing.Tracer`; spans record on
+        #: the caller thread (work executes lazily inside ``get_results``).
+        self.tracer = tracer
 
     @property
     def workers_count(self) -> int:
@@ -52,6 +55,11 @@ class DummyPool:
                     counts, gauges = self._worker.drain_stat_counts()
                     self.stats.merge_counts(counts)
                     self.stats.merge_gauges(gauges)
+                if self.tracer is not None:
+                    self.tracer.add_span('process_item', 'worker', start,
+                                         elapsed)
+                    if hasattr(self._worker, 'drain_spans'):
+                        self.tracer.merge(self._worker.drain_spans())
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
                 continue

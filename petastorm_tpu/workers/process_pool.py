@@ -77,10 +77,16 @@ class ProcessPool:
     #: — readers may enable ``io_readahead`` on this pool.
     supports_prefetch_hints = True
 
-    def __init__(self, workers_count: int, serializer=None, zmq_copy_buffers: bool = True):
+    def __init__(self, workers_count: int, serializer=None, zmq_copy_buffers: bool = True,
+                 tracer=None):
         self._workers_count = workers_count
         self._serializer = as_multipart(serializer or PickleSerializer())
         self._zmq_copy_buffers = zmq_copy_buffers
+        #: Optional :class:`petastorm_tpu.tracing.Tracer`. Worker processes
+        #: record spans locally and ship batches back inside the per-item
+        #: accounting message (same pattern as the stage times); the pool
+        #: merges them here with their original (pid, tid) tracks.
+        self.tracer = tracer
         self._processes = []
         self._ventilator = None
         self._context = None
@@ -184,6 +190,7 @@ class ProcessPool:
 
     def get_results(self, timeout: Optional[float] = None):
         deadline = None if timeout is None else time.monotonic() + timeout
+        entered = time.perf_counter()
         while True:
             if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutWaitingForResultError(
@@ -220,8 +227,15 @@ class ProcessPool:
                 with self._accounting_lock:
                     self._results_produced += 1
                 copies_before = getattr(self._serializer, 'copies', 0)
+                deser_start = time.perf_counter()
                 with self.stats.timed('deserialize_s'):
                     result = self._serializer.deserialize_multipart(payload_frames)
+                if self.tracer is not None:
+                    now = time.perf_counter()
+                    self.tracer.add_span('queue_wait', 'consumer', entered,
+                                         deser_start - entered)
+                    self.tracer.add_span('deserialize', 'transport',
+                                         deser_start, now - deser_start)
                 # consumer-side deserialize copies count too (worker-side
                 # copies arrive via the accounting message) — the counter
                 # must cover both ends of the hop
@@ -241,6 +255,8 @@ class ProcessPool:
         self.stats.merge_times(item_stats.get('times'))
         self.stats.merge_counts(item_stats.get('counts'))
         self.stats.merge_gauges(item_stats.get('gauges'))
+        if self.tracer is not None:
+            self.tracer.merge(item_stats.get('spans'))
         for counter in ('payload_copies',):
             n = item_stats.get(counter)
             if n:
@@ -338,6 +354,12 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
     # Per-item stage accounting, shipped back inside the processed-item
     # control message (the consumer-side pool merges it into its stats).
     item = {'serialize_s': 0.0, 'publish_wait_s': 0.0, 'copies_before': 0}
+    trace_enabled = isinstance(worker_args, dict) and bool(worker_args.get('trace'))
+    # bootstrap-level spans (serialize, process_item) ride back with the
+    # worker's own spans in the accounting message; (pid, tid) attribution
+    # keeps each worker interpreter on its own trace track
+    item_spans = []
+    trace_pid = os.getpid()
 
     def send(payload_frames, control):
         message = [payload_frames[0], pickle.dumps(control)] + list(payload_frames[1:])
@@ -353,7 +375,12 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
     def publish(data):
         start = time.perf_counter()
         frames = serializer.serialize_multipart(data)
-        item['serialize_s'] += time.perf_counter() - start
+        serialized = time.perf_counter()
+        item['serialize_s'] += serialized - start
+        if trace_enabled:
+            item_spans.append(('serialize', 'transport', start,
+                               serialized - start, trace_pid,
+                               threading.get_ident(), None))
         send(frames, _DATA)
 
     try:
@@ -426,6 +453,14 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
                     item_stats['counts'] = counts
                 if gauges:
                     item_stats['gauges'] = gauges
+            if trace_enabled:
+                item_spans.append(('process_item', 'worker', process_start,
+                                   elapsed, trace_pid, threading.get_ident(),
+                                   None))
+                spans = item_spans + (worker.drain_spans()
+                                      if hasattr(worker, 'drain_spans') else [])
+                item_spans = []
+                item_stats['spans'] = spans
             send([b''], VentilatedItemProcessedMessage(stats=item_stats))
     finally:
         worker.shutdown()

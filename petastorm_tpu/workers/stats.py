@@ -52,23 +52,41 @@ COUNTERS = (
 GAUGES = ('queue_depth', 'shuffle_buffer_depth', 'readahead_depth')
 
 #: Derived keys added to every snapshot (not accumulated directly).
-DERIVED = ('io_overlap_fraction',)
+#: ``items_per_s``/``mb_per_s`` are rates over the snapshot window — the time
+#: since construction or the last :meth:`ReaderStats.reset` — so benchmarks
+#: that ``reset()`` after warmup read steady-state rates, and the metrics
+#: emitter / throughput CLI stop recomputing them ad hoc.
+DERIVED = ('io_overlap_fraction', 'window_s', 'items_per_s', 'mb_per_s')
+
+_MB = 1024.0 * 1024.0
 
 
 class ReaderStats:
     """Thread-safe per-stage accumulator. All keys exist from construction so
     ``snapshot()`` has a stable schema regardless of pool type."""
 
-    __slots__ = ('_lock', '_times', '_counts', '_gauges')
+    __slots__ = ('_lock', '_times', '_counts', '_gauges', '_window_start')
 
     def __init__(self):
         self._lock = threading.Lock()
+        self._init_locked()
+
+    def _init_locked(self):
         self._times = {stage: 0.0 for stage in TIME_STAGES}
         self._counts = {name: 0 for name in COUNTERS}
         self._gauges = {}
         for name in GAUGES:
             self._gauges[name] = 0
             self._gauges[name + '_max'] = 0
+        self._window_start = time.perf_counter()
+
+    def reset(self) -> None:
+        """Zero every stage/counter/gauge and restart the snapshot window.
+        Benchmarks call this after warmup so the measured window excludes
+        warmup decode/io (and the derived rates cover only what was
+        measured)."""
+        with self._lock:
+            self._init_locked()
 
     def add_time(self, stage: str, seconds: float) -> None:
         with self._lock:
@@ -120,17 +138,26 @@ class ReaderStats:
 
     def snapshot(self) -> dict:
         """One flat dict of every stage/counter/gauge (stable key set), plus
-        the derived ``io_overlap_fraction``: the share of readahead read time
-        hidden behind decode (``1 - readahead_wait_s / readahead_io_s``; 0.0
-        when readahead is off)."""
+        the derived keys: ``io_overlap_fraction`` (share of readahead read
+        time hidden behind decode, ``1 - readahead_wait_s / readahead_io_s``;
+        0.0 when readahead is off), ``window_s`` (seconds since construction
+        or the last :meth:`reset`), and the window rates ``items_per_s`` /
+        ``mb_per_s`` (items and payload MB delivered per window second;
+        ``mb_per_s`` is 0 for in-process pools, which move no transport
+        bytes)."""
         with self._lock:
             out = dict(self._times)
             out.update(self._counts)
             out.update(self._gauges)
+            window = time.perf_counter() - self._window_start
         ra_io = out.get('readahead_io_s', 0.0)
         ra_wait = out.get('readahead_wait_s', 0.0)
         out['io_overlap_fraction'] = (
             max(0.0, 1.0 - ra_wait / ra_io) if ra_io > 0 else 0.0)
+        out['window_s'] = window
+        out['items_per_s'] = out['items_out'] / window if window > 0 else 0.0
+        out['mb_per_s'] = (out['bytes_moved'] / _MB / window
+                           if window > 0 else 0.0)
         return out
 
 

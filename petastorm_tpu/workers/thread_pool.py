@@ -111,6 +111,11 @@ class WorkerThread(threading.Thread):
                     counts, gauges = self._worker.drain_stat_counts()
                     stats.merge_counts(counts)
                     stats.merge_gauges(gauges)
+                tracer = self._pool.tracer
+                if tracer is not None:
+                    tracer.add_span('process_item', 'worker', start, elapsed)
+                    if hasattr(self._worker, 'drain_spans'):
+                        tracer.merge(self._worker.drain_spans())
                 self._pool._put_result(VentilatedItemProcessedMessage())
         finally:
             if self._profiler:
@@ -127,11 +132,14 @@ class ThreadPool:
     supports_prefetch_hints = True
 
     def __init__(self, workers_count: int, results_queue_size: int = _RESULTS_QUEUE_SIZE_DEFAULT,
-                 profiling_enabled: bool = False):
+                 profiling_enabled: bool = False, tracer=None):
         self._workers_count = workers_count
         self._work_queue: queue.Queue = queue.Queue()
         self._results_queue: queue.Queue = queue.Queue(maxsize=results_queue_size)
         self._profiling_enabled = profiling_enabled
+        #: Optional :class:`petastorm_tpu.tracing.Tracer`; worker threads
+        #: record process/io/decode spans into it directly.
+        self.tracer = tracer
         self._profiles = []
         self._profiles_lock = threading.Lock()
         self._stop_event = threading.Event()
@@ -193,6 +201,7 @@ class ThreadPool:
 
     def get_results(self, timeout: Optional[float] = None):
         deadline = None if timeout is None else time.monotonic() + timeout
+        entered = time.perf_counter()
         while True:
             if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutWaitingForResultError(
@@ -226,6 +235,10 @@ class ThreadPool:
                 raise item.exc
             self.stats.gauge('queue_depth', self._results_queue.qsize())
             self.stats.add('items_out')
+            if self.tracer is not None:
+                now = time.perf_counter()
+                self.tracer.add_span('queue_wait', 'consumer', entered,
+                                     now - entered)
             return item
 
     def stop(self):

@@ -1,5 +1,7 @@
 """Worker protocol (reference ``petastorm/workers_pool/worker_base.py:18-35``)."""
 
+import os
+import threading
 from abc import ABC, abstractmethod
 
 
@@ -21,6 +23,12 @@ class WorkerBase(ABC):
         #: drain discipline as :attr:`stage_times`.
         self.stat_counts = {}
         self.stat_gauges = {}
+        #: Span tuples accumulated since the last drain (see
+        #: :mod:`petastorm_tpu.tracing`); recorded only when the pool enabled
+        #: tracing via ``worker_args['trace']``, drained like the stats.
+        self.trace_spans = []
+        self.tracing_enabled = isinstance(args, dict) and bool(args.get('trace'))
+        self._trace_pid = os.getpid()
 
     @abstractmethod
     def process(self, *args, **kwargs):
@@ -51,6 +59,22 @@ class WorkerBase(ABC):
         counts, self.stat_counts = self.stat_counts, {}
         gauges, self.stat_gauges = self.stat_gauges, {}
         return counts, gauges
+
+    def record_span(self, name: str, cat: str, start_s: float, dur_s: float,
+                    args=None) -> None:
+        """Record one trace span (``start_s`` on the ``time.perf_counter()``
+        clock), stamped with this process/thread as its track. No-op unless
+        the pool enabled tracing."""
+        if not self.tracing_enabled:
+            return
+        self.trace_spans.append((name, cat, start_s, dur_s, self._trace_pid,
+                                 threading.get_ident(), args))
+
+    def drain_spans(self) -> list:
+        """Return and reset the accumulated trace spans (same drain
+        discipline as :meth:`drain_stage_times`)."""
+        spans, self.trace_spans = self.trace_spans, []
+        return spans
 
     def shutdown(self):
         """Optional cleanup hook invoked when the pool stops."""

@@ -17,7 +17,35 @@ class TestReaderStatsUnit:
     def test_snapshot_has_stable_key_set(self):
         snap = ReaderStats().snapshot()
         assert set(stage_keys()) <= set(snap)
-        assert all(v == 0 for v in snap.values())
+        # window_s ticks from construction; every accumulated key starts at 0
+        assert snap['window_s'] > 0
+        assert all(v == 0 for k, v in snap.items() if k != 'window_s')
+
+    def test_reset_zeroes_and_restarts_window(self):
+        stats = ReaderStats()
+        stats.add_time('worker_io_s', 2.0)
+        stats.add('items_out', 10)
+        stats.gauge('queue_depth', 5)
+        time.sleep(0.02)
+        before = stats.snapshot()
+        assert before['items_per_s'] > 0
+        stats.reset()
+        snap = stats.snapshot()
+        assert all(v == 0 for k, v in snap.items() if k != 'window_s')
+        assert snap['window_s'] < before['window_s']
+        assert snap['queue_depth_max'] == 0
+
+    def test_snapshot_window_rates(self):
+        """items_per_s / mb_per_s are rates over the window since
+        construction/reset — the one derivation the metrics emitter and the
+        CLI diagnostics output share."""
+        stats = ReaderStats()
+        stats.add('items_out', 100)
+        stats.add('bytes_moved', 50 * 1024 * 1024)
+        snap = stats.snapshot()
+        # both rates divide by the same window captured in this snapshot
+        assert snap['items_per_s'] == pytest.approx(100 / snap['window_s'])
+        assert snap['mb_per_s'] == pytest.approx(50 / snap['window_s'])
 
     def test_accumulation_and_gauges(self):
         stats = ReaderStats()
