@@ -42,8 +42,15 @@ ANNOTATION = re.compile(
 #: documents under the gate; every measured number they display must be
 #: annotated (MIN_ANNOTATIONS guards against the gate being emptied out)
 DEFAULT_DOCS = ('docs/benchmarks.md', 'docs/transport.md',
-                'docs/readahead.md', 'docs/tracing.md')
+                'docs/readahead.md', 'docs/tracing.md', 'docs/health.md')
 MIN_ANNOTATIONS = 30
+
+#: Artifacts that MUST be quoted by at least one annotation across the
+#: default docs: a recorded benchmark nobody displays is a claim nobody can
+#: check (round-9 extension — BENCH_r09 must be referenced from the docs,
+#: and the earlier per-PR artifacts stay referenced too).
+REQUIRED_ARTIFACTS = ('BENCH_r06.json', 'BENCH_r07.json', 'BENCH_r08.json',
+                      'BENCH_r09.json')
 
 
 def _lookup(blob, keypath: str):
@@ -108,10 +115,13 @@ def check_file(doc_path: str, fix: bool = False):
     cache = {}
     errors = []
     count = 0
+    referenced = set()
 
     def handle(match):
         nonlocal count
         count += 1
+        spec = match.group('spec').split()
+        referenced.update(part for part in spec if part.endswith('.json'))
         display = ' '.join(match.group('display').split())
         try:
             expected = _derive(cache, match.group('spec'))
@@ -132,7 +142,7 @@ def check_file(doc_path: str, fix: bool = False):
     if fix and new_text != text:
         with open(os.path.join(ROOT, doc_path), 'w') as f:
             f.write(new_text)
-    return count, errors
+    return count, errors, referenced
 
 
 def main(argv):
@@ -143,14 +153,22 @@ def main(argv):
     docs = args or [os.path.join(*d.split('/')) for d in DEFAULT_DOCS]
     total = 0
     all_errors = []
+    all_referenced = set()
     for doc in docs:
-        count, errors = check_file(doc, fix=fix)
+        count, errors, referenced = check_file(doc, fix=fix)
         total += count
         all_errors.extend(errors)
+        all_referenced.update(referenced)
     if total < MIN_ANNOTATIONS and not args:
         all_errors.append(
             'only {} bench annotations found (expected >= {}): the gate '
             'must not be emptied out'.format(total, MIN_ANNOTATIONS))
+    if not args:
+        for artifact in REQUIRED_ARTIFACTS:
+            if artifact not in all_referenced:
+                all_errors.append(
+                    'required artifact {} is not referenced by any bench '
+                    'annotation in the default docs'.format(artifact))
     if all_errors:
         for err in all_errors:
             print('BENCH-DOCS MISMATCH: {}'.format(err), file=sys.stderr)

@@ -37,6 +37,12 @@ python -m petastorm_tpu.benchmark.readahead --quick
 echo '== trace-overhead quick bench (span tracer on vs off) =='
 python -m petastorm_tpu.benchmark.trace_overhead --quick
 
+echo '== health quick checks (watchdog + debug endpoint + wedge fixtures) =='
+python -m pytest tests/test_health.py -q
+
+echo '== health-overhead quick bench (heartbeats+watchdog+endpoint on vs off) =='
+python -m petastorm_tpu.benchmark.health_overhead --quick
+
 echo '== bench-docs consistency gate =='
 python ci/check_bench_docs.py
 

@@ -54,6 +54,15 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument('--metrics-out', metavar='PATH', default=None,
                         help='Metrics emitter output: JSON-lines, or '
                              'Prometheus text exposition for .prom paths')
+    parser.add_argument('--debug-port', type=int, default=None,
+                        help='Serve the live health endpoints on '
+                             '127.0.0.1:PORT while the benchmark runs '
+                             '(/healthz /metrics /diagnostics /stacks; 0 = '
+                             'ephemeral; see docs/health.md)')
+    parser.add_argument('--stall-timeout', type=float, default=0,
+                        help='Arm the pipeline watchdog: classify the reader '
+                             'stalled (and write a flight-recorder JSON) '
+                             'after N seconds without entity progress')
     parser.add_argument('-v', action='store_true', help='INFO logging')
     return parser
 
@@ -75,7 +84,8 @@ def main(argv=None) -> int:
         jax_batch_size=args.jax_batch_size,
         io_readahead=io_readahead, trace_path=args.trace,
         metrics_interval=args.metrics_interval,
-        metrics_out=args.metrics_out) for _ in range(max(1, args.runs))]
+        metrics_out=args.metrics_out, debug_port=args.debug_port,
+        stall_timeout=args.stall_timeout) for _ in range(max(1, args.runs))]
     # headline = median run: the honest central figure (best would overstate)
     by_rate = sorted(results, key=lambda r: r.samples_per_sec)
     result = by_rate[len(by_rate) // 2]
@@ -94,6 +104,11 @@ def main(argv=None) -> int:
         print('Pipeline telemetry (median run): {}'.format(
             json.dumps({k: (round(v, 4) if isinstance(v, float) else v)
                         for k, v in sorted(result.diagnostics.items())})))
+        if result.diagnosis is not None:
+            # the same classification the watchdog / GET /healthz makes
+            # (infeed_diagnosis over the snapshot + live heartbeats)
+            print('Infeed diagnosis (median run): {}'.format(
+                json.dumps(result.diagnosis, sort_keys=True)))
     if args.trace:
         print('Chrome trace written to {} (open in https://ui.perfetto.dev)'
               .format(args.trace))

@@ -28,6 +28,11 @@ class ThroughputResult:
     #: gauges and derived ``items_per_s``/``mb_per_s`` cover the measured
     #: samples only.
     diagnostics: Optional[dict] = None
+    #: ``infeed_diagnosis(diagnostics, heartbeats=...)`` over the measured
+    #: window — the classification (bottleneck + pipeline_state) the CLI's
+    #: ``-d`` prints, computed with the live heartbeats folded in so it can
+    #: never disagree with the watchdog / ``/healthz``.
+    diagnosis: Optional[dict] = None
 
 
 def _consume(iterator, count: int, batched: bool) -> int:
@@ -58,7 +63,9 @@ def reader_throughput(dataset_url: str,
                       trace=None,
                       trace_path: Optional[str] = None,
                       metrics_interval: float = 0,
-                      metrics_out: Optional[str] = None) -> ThroughputResult:
+                      metrics_out: Optional[str] = None,
+                      debug_port=None,
+                      stall_timeout: float = 0) -> ThroughputResult:
     """Measure reader throughput on ``dataset_url``.
 
     ``read_method='python'`` iterates raw reader rows/batches;
@@ -68,7 +75,9 @@ def reader_throughput(dataset_url: str,
     ``trace_path`` enables per-item span tracing and exports the chrome
     trace of the measured window (warmup spans are dropped) there;
     ``metrics_interval``/``metrics_out`` run the continuous metrics emitter
-    alongside the measurement.
+    alongside the measurement; ``debug_port``/``stall_timeout`` arm the live
+    health endpoint/watchdog on the benchmarked reader (see
+    ``docs/health.md``).
     """
     import psutil
 
@@ -77,7 +86,8 @@ def reader_throughput(dataset_url: str,
         trace = True
     kwargs = dict(reader_pool_type=pool_type, workers_count=workers_count,
                   num_epochs=None, io_readahead=io_readahead, trace=trace,
-                  metrics_interval=metrics_interval, metrics_out=metrics_out)
+                  metrics_interval=metrics_interval, metrics_out=metrics_out,
+                  debug_port=debug_port, stall_timeout=stall_timeout)
     if field_regex is not None:
         kwargs['schema_fields'] = field_regex
 
@@ -110,6 +120,14 @@ def reader_throughput(dataset_url: str,
         cpu = proc.cpu_percent()
         rss = proc.memory_info().rss / (1024.0 * 1024.0)
         diagnostics = reader.diagnostics
+        from petastorm_tpu.jax_utils import infeed_diagnosis
+        health = getattr(reader, 'health', None)
+        watchdog = getattr(reader, 'watchdog', None)
+        diagnosis = infeed_diagnosis(
+            diagnostics,
+            heartbeats=health.heartbeats() if health is not None else None,
+            stall_after_s=watchdog.stall_after_s
+            if watchdog is not None else None)
         if trace_path is not None and reader.tracer is not None:
             reader.tracer.export_chrome_trace(trace_path)
 
@@ -117,4 +135,5 @@ def reader_throughput(dataset_url: str,
                             warmup_cycles=warmup_cycles,
                             measure_cycles=actual,
                             rss_mb=rss, cpu_percent=cpu,
-                            diagnostics=diagnostics)
+                            diagnostics=diagnostics,
+                            diagnosis=diagnosis)

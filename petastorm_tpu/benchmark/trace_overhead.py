@@ -25,9 +25,9 @@ The full run asserts **overhead < 5%** (the BENCH_r08 acceptance bar);
 (sub-second passes are noise-dominated; the quick gate exists to catch a
 rewrite that makes tracing accidentally hot, not to re-prove the 5% claim).
 
-CLI::
+CLI (output is always JSON)::
 
-    python -m petastorm_tpu.benchmark.trace_overhead [--quick] [--json]
+    python -m petastorm_tpu.benchmark.trace_overhead [--quick] [--no-check]
 """
 
 from __future__ import annotations

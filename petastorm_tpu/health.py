@@ -1,0 +1,564 @@
+"""Live pipeline health: heartbeats, stall watchdog, flight recorder, and an
+HTTP debug endpoint.
+
+The reader is a multi-stage pipeline (ventilator → worker pool → transport →
+loader → device staging) whose dominant production failure mode is not a
+crash but a **silent stall** — a wedged worker, a full result queue, a
+starving infeed that only shows up as a slow train step. The post-hoc layers
+(``ReaderStats``, spans, the metrics emitter — see ``docs/tracing.md``) tell
+you what happened after you attach to the job; this module is the *live*
+layer: the pipeline reports its own health while running, detects that it is
+stuck, and dumps a diagnosis automatically.
+
+Three pieces:
+
+- **Heartbeats.** Every long-lived pipeline entity — each worker (thread and
+  process pools), the ventilator thread, each worker's background readahead
+  reader thread, the loader's prefetch thread — publishes a per-entity
+  record: current stage (``idle``/``io``/``decode``/...), a monotonic
+  last-progress timestamp, and items completed. In-process entities publish
+  through :class:`~petastorm_tpu.workers.worker_base.WorkerBase` (the pool
+  reads their records directly); process workers piggyback their records on
+  the existing per-item accounting control message *plus* a low-frequency
+  ZMQ heartbeat frame, so an item that legitimately takes minutes still
+  beats. Timestamps are ``time.perf_counter()`` readings — CLOCK_MONOTONIC
+  on Linux, comparable across local processes (the same clock contract as
+  the span tracer).
+- **Watchdog.** :class:`PipelineWatchdog` evaluates the heartbeat records
+  against a stall threshold and classifies the pipeline as ``healthy`` /
+  ``degraded`` / ``stalled`` / ``starving``, using the same bottleneck
+  signals as ``jax_utils.infeed_diagnosis`` (one classification, two
+  consumers). On a transition into ``stalled`` it fires its ``on_stall``
+  callback once per episode — the ``Reader`` wires that to a
+  **flight-recorder dump**: one JSON artifact with per-entity heartbeats,
+  the stats snapshot, queue occupancy, faulthandler-style stacks of every
+  in-process thread, and the tail of the tracer's span ring when tracing is
+  on.
+- **HTTP debug endpoint.** :class:`DebugServer` is an opt-in stdlib
+  ``http.server`` thread (``debug_port=`` on the reader factories, the
+  ``PETASTORM_TPU_DEBUG_PORT`` env var, or ``--debug-port`` on the CLI)
+  serving ``GET /healthz`` (200/503 from the watchdog verdict), ``/metrics``
+  (Prometheus text, same formatter as the metrics emitter),
+  ``/diagnostics`` (stats + heartbeats + verdict as JSON) and ``/stacks``.
+
+Heartbeat publishing is on by default and costs a few attribute assignments
+per item (measured ~0 on the throughput bench, ``BENCH_r09.json``); set
+``PETASTORM_TPU_HEALTH=0`` to compile it out of the workers entirely. The
+watchdog thread and HTTP server only exist when requested
+(``stall_timeout=`` / ``debug_port=``). See ``docs/health.md``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+#: Environment variable gating heartbeat publication (default on).
+#: ``0``/``false``/``off`` disable every beat call site.
+HEALTH_ENV_VAR = 'PETASTORM_TPU_HEALTH'
+
+#: Environment variable naming the debug-endpoint port when the
+#: ``debug_port=`` kwarg is left at its default. ``0`` binds an ephemeral
+#: port (read it back from ``reader.debug_port``).
+DEBUG_PORT_ENV_VAR = 'PETASTORM_TPU_DEBUG_PORT'
+
+#: Default stall threshold (seconds an entity may sit in an active stage
+#: without progress before the pipeline is classified ``stalled``). Used for
+#: on-demand verdicts (``/healthz`` with no ``stall_timeout=``); row-group
+#: decode on cold object stores can legitimately take tens of seconds.
+DEFAULT_STALL_AFTER_S = 120.0
+
+#: Pipeline states, from best to worst.
+HEALTHY, DEGRADED, STARVING, STALLED = ('healthy', 'degraded', 'starving',
+                                        'stalled')
+
+#: Stages that mean "waiting for work, not doing it" — age in these stages
+#: is never a stall. ``backpressured`` is the ventilator blocked on its
+#: in-flight bound (the stall, if any, is downstream); ``starting`` covers
+#: the gap between entity construction and its first work item.
+IDLE_STAGES = frozenset({'idle', 'done', 'stopped', 'backpressured',
+                         'starting'})
+
+
+def heartbeats_enabled() -> bool:
+    """The :data:`HEALTH_ENV_VAR` gate (default on)."""
+    value = os.environ.get(HEALTH_ENV_VAR, '').strip().lower()
+    return value not in ('0', 'false', 'off')
+
+
+def resolve_debug_port(debug_port) -> Optional[int]:
+    """Resolve the ``debug_port=`` kwarg against :data:`DEBUG_PORT_ENV_VAR`.
+
+    ``None`` defers to the env var (unset/empty → no server); an int is the
+    port to bind (``0`` = ephemeral). Returns ``None`` when no server should
+    run. A malformed env value disables the endpoint with a warning instead
+    of raising: a job-wide observability env var must never kill the
+    pipeline it observes (an explicit bad ``debug_port=`` kwarg still
+    raises — that is a programming error at the call site)."""
+    if debug_port is None:
+        value = os.environ.get(DEBUG_PORT_ENV_VAR, '').strip()
+        if not value:
+            return None
+        try:
+            port = int(value)
+            if not 0 <= port <= 65535:
+                raise ValueError(port)
+        except ValueError:
+            logger.warning('debug endpoint disabled: %s=%r is not a port '
+                           'number', DEBUG_PORT_ENV_VAR, value)
+            return None
+        return port
+    return int(debug_port)
+
+
+class HeartbeatRegistry:
+    """Thread-safe store of per-entity heartbeat records.
+
+    A record is ``{'stage': str, 'ts': float, 'items': int, 'pid': int}``
+    with ``ts`` a ``time.perf_counter()`` reading; :meth:`snapshot` adds the
+    derived ``age_s``."""
+
+    __slots__ = ('_lock', '_records')
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._records: Dict[str, dict] = {}
+
+    def beat(self, entity: str, stage: str, items: Optional[int] = None,
+             pid: Optional[int] = None) -> None:
+        """Record progress for ``entity``: it is now in ``stage`` and (when
+        given) has completed ``items`` work items."""
+        record = {'stage': stage, 'ts': time.perf_counter(),
+                  'pid': os.getpid() if pid is None else pid}
+        with self._lock:
+            prev = self._records.get(entity)
+            record['items'] = (items if items is not None
+                               else (prev or {}).get('items', 0))
+            self._records[entity] = record
+
+    def update(self, records: Dict[str, dict]) -> None:
+        """Replace entity records wholesale (records shipped back from a
+        process worker already carry their own ``ts``/``pid``)."""
+        if not records:
+            return
+        with self._lock:
+            self._records.update(records)
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """Point-in-time copy of every record with ``age_s`` derived."""
+        now = time.perf_counter() if now is None else now
+        with self._lock:
+            records = {entity: dict(record)
+                       for entity, record in self._records.items()}
+        for record in records.values():
+            record['age_s'] = max(0.0, now - record['ts'])
+        return records
+
+
+class HealthMonitor:
+    """Aggregates the heartbeat sources of one reader pipeline.
+
+    Non-pool entities (ventilator, loader prefetch thread) :meth:`beat`
+    directly into the monitor's own registry; the pool contributes a live
+    source callable (``pool.heartbeats``) merged at :meth:`heartbeats` time,
+    so in-process worker records are read fresh rather than forwarded."""
+
+    def __init__(self):
+        self._registry = HeartbeatRegistry()
+        self._sources: List[Callable[[], Dict[str, dict]]] = []
+        self.enabled = heartbeats_enabled()
+
+    def beat(self, entity: str, stage: str, items: Optional[int] = None) -> None:
+        if self.enabled:
+            self._registry.beat(entity, stage, items=items)
+
+    def add_source(self, source: Callable[[], Dict[str, dict]]) -> None:
+        """Register a callable returning ``{entity: record}`` (records carry
+        their own ``ts``; ``age_s`` is derived here)."""
+        self._sources.append(source)
+
+    def heartbeats(self) -> Dict[str, dict]:
+        """Merged per-entity records across the registry and every source,
+        each with derived ``age_s``."""
+        now = time.perf_counter()
+        merged = self._registry.snapshot(now)
+        for source in self._sources:
+            try:
+                records = source()
+            except Exception:  # a dying pool must not break health reporting
+                logger.debug('heartbeat source %r failed', source,
+                             exc_info=True)
+                continue
+            for entity, record in (records or {}).items():
+                record = dict(record)
+                record['age_s'] = max(0.0, now - record.get('ts', now))
+                merged[entity] = record
+        return merged
+
+
+def bottleneck_signals(snapshot: dict) -> dict:
+    """Classify the io/decode/consumer bottleneck from a ``ReaderStats``
+    snapshot — the one definition shared by ``jax_utils.infeed_diagnosis``
+    and :func:`classify_pipeline` (the watchdog), so the CLI's ``-d`` output
+    and ``/healthz`` can never disagree.
+
+    Returns ``{'bottleneck', 'hint', 'io_s', 'decode_s'}``; thresholds and
+    wording match ``docs/troubleshooting.md``."""
+    from petastorm_tpu.workers.stats import effective_io_s
+    io_s = effective_io_s(snapshot)
+    decode_s = snapshot.get('worker_decode_s', 0.0)
+    publish_wait_s = snapshot.get('worker_publish_wait_s', 0.0)
+    busy = io_s + decode_s
+    if publish_wait_s > busy:
+        bottleneck = 'consumer'
+        hint = ('workers outrun the consumer (publish_wait > io+decode): '
+                'the training step / consumer loop is the ceiling')
+    elif io_s > decode_s * 1.5:
+        bottleneck = 'io'
+        hint = ('storage stall dominates: raise io_readahead (or pass '
+                "io_readahead='auto') before raising workers_count")
+    elif decode_s > io_s * 1.5:
+        bottleneck = 'decode'
+        hint = ('decode dominates and reads are hidden: raise workers_count '
+                'or cut decode work (decode_hints, lighter transforms)')
+    else:
+        bottleneck = 'balanced'
+        hint = ('io and decode are comparable: io_readahead overlaps them '
+                'for up to 2x; workers_count scales both')
+    return {'bottleneck': bottleneck, 'hint': hint, 'io_s': io_s,
+            'decode_s': decode_s}
+
+
+def classify_pipeline(heartbeats: Dict[str, dict],
+                      snapshot: Optional[dict] = None,
+                      stall_after_s: float = DEFAULT_STALL_AFTER_S) -> dict:
+    """Classify a pipeline from its heartbeat records (as returned by
+    ``HealthMonitor.heartbeats()``) and an optional stats snapshot.
+
+    - ``stalled`` — some entity has sat in an **active** (non-idle) stage
+      for longer than ``stall_after_s`` without progress; the verdict names
+      every such entity and its stage.
+    - ``degraded`` — no entity over the threshold, but at least one active
+      entity is past half of it (the early warning the watchdog logs).
+    - ``starving`` — entities are healthy but the io bottleneck signal fires
+      with an empty result queue: storage cannot feed the consumer (the
+      device is starving, not the pipeline wedged).
+    - ``healthy`` — everything else, including a fully idle pipeline.
+    """
+    now = time.perf_counter()
+    stalled, slow = [], []
+    for entity, record in sorted(heartbeats.items()):
+        stage = record.get('stage', 'idle')
+        if stage in IDLE_STAGES:
+            continue
+        age = record.get('age_s')
+        if age is None:
+            # raw records (straight off a pool or registry) carry only the
+            # beat timestamp; derive the age here so classification works on
+            # any heartbeat source
+            age = max(0.0, now - record.get('ts', now))
+        brief = {'entity': entity, 'stage': stage, 'age_s': round(age, 3)}
+        if age > stall_after_s:
+            stalled.append(brief)
+        elif age > stall_after_s / 2.0:
+            slow.append(brief)
+    verdict = {
+        'state': HEALTHY,
+        'stall_after_s': stall_after_s,
+        'entities': len(heartbeats),
+        'stalled_entities': stalled,
+        'slow_entities': slow,
+    }
+    if stalled:
+        verdict['state'] = STALLED
+        verdict['hint'] = ('no progress from {} for > {:.0f}s: dump stacks '
+                           '(/stacks or the flight record) to see where it '
+                           'is wedged'.format(
+                               ', '.join(e['entity'] for e in stalled),
+                               stall_after_s))
+        return verdict
+    if slow:
+        verdict['state'] = DEGRADED
+        verdict['hint'] = ('{} past half the stall threshold: a stall dump '
+                           'fires at {:.0f}s'.format(
+                               ', '.join(e['entity'] for e in slow),
+                               stall_after_s))
+        return verdict
+    if snapshot:
+        signals = bottleneck_signals(snapshot)
+        verdict['bottleneck'] = signals['bottleneck']
+        if (signals['bottleneck'] == 'io'
+                and snapshot.get('queue_depth', 0) == 0
+                and snapshot.get('items_out', 0) > 0):
+            verdict['state'] = STARVING
+            verdict['hint'] = ('storage cannot feed the consumer (io-bound, '
+                               'result queue empty): ' + signals['hint'])
+        else:
+            verdict['hint'] = signals['hint']
+    return verdict
+
+
+def thread_stacks() -> Dict[str, str]:
+    """Faulthandler-style stack dumps of every thread in this process,
+    keyed ``'<thread name> (tid)'`` — what the flight recorder and the
+    ``/stacks`` endpoint serve. Pure stdlib (``sys._current_frames``), no
+    signal handling, safe to call from any thread."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks = {}
+    for tid, frame in sys._current_frames().items():
+        label = '{} ({})'.format(names.get(tid, '<unknown>'), tid)
+        stacks[label] = ''.join(traceback.format_stack(frame))
+    return stacks
+
+
+def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
+                        snapshot: Optional[dict] = None,
+                        queues: Optional[dict] = None,
+                        tracer=None, span_tail: int = 500) -> dict:
+    """Assemble the flight-recorder artifact: everything needed to diagnose
+    a stall *after* the process is gone. JSON-able by construction."""
+    record = {
+        'kind': 'petastorm_tpu_flight_record',
+        'written_at': time.time(),
+        'pid': os.getpid(),
+        'verdict': verdict,
+        'heartbeats': heartbeats,
+        'stats': snapshot or {},
+        'queues': queues or {},
+        'stacks': thread_stacks(),
+    }
+    if tracer is not None:
+        record['span_tail'] = tracer.tail(span_tail)
+        record['spans_dropped'] = tracer.dropped
+    return record
+
+
+def write_flight_record(path: str, record: dict) -> str:
+    """Write one flight record as JSON; returns ``path``."""
+    tmp = '{}.tmp.{}'.format(path, os.getpid())
+    with open(tmp, 'w') as f:
+        json.dump(record, f, indent=2, sort_keys=True, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+class PipelineWatchdog:
+    """Background stall detector over a pipeline's heartbeats.
+
+    :meth:`evaluate` is cheap and callable on demand (the ``/healthz``
+    endpoint does); :meth:`start` adds a daemon thread re-evaluating every
+    ``interval_s`` that fires ``on_stall(verdict)`` once per stall episode
+    (edge-triggered: it re-arms when the pipeline recovers). Lifecycle
+    mirrors ``MetricsEmitter``: ``stop(join=True)`` joins with a timeout and
+    is idempotent, so ``Reader.stop()/join()`` can always call it — even
+    when the pool died uncleanly.
+    """
+
+    def __init__(self, heartbeats_fn: Callable[[], Dict[str, dict]],
+                 snapshot_fn: Optional[Callable[[], dict]] = None,
+                 stall_after_s: float = DEFAULT_STALL_AFTER_S,
+                 interval_s: Optional[float] = None,
+                 on_stall: Optional[Callable[[dict], None]] = None):
+        if stall_after_s <= 0:
+            raise ValueError('stall_after_s must be positive, got '
+                             '{!r}'.format(stall_after_s))
+        self._heartbeats_fn = heartbeats_fn
+        self._snapshot_fn = snapshot_fn
+        self._stall_after_s = stall_after_s
+        # default tick: a quarter of the threshold, clamped so tiny test
+        # thresholds do not busy-spin and huge ones still tick regularly
+        self._interval = (interval_s if interval_s is not None
+                          else min(5.0, max(0.05, stall_after_s / 4.0)))
+        self._on_stall = on_stall
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stall_fired = False
+        self._last_items_out = 0
+        #: The most recent verdict (from the thread or an explicit
+        #: :meth:`evaluate` call); ``None`` until the first evaluation.
+        self.last_verdict: Optional[dict] = None
+
+    @property
+    def stall_after_s(self) -> float:
+        return self._stall_after_s
+
+    def evaluate(self, _advance_progress_window: bool = False) -> dict:
+        """Classify the pipeline right now; updates :attr:`last_verdict`.
+
+        ``items_out_delta`` is progress since the watchdog thread's previous
+        tick. Only the thread advances that baseline
+        (``_advance_progress_window``): on-demand callers (``/healthz``, a
+        k8s probe every few seconds) must not reset it, or the delta in a
+        stall's flight record would cover whatever arbitrary window the last
+        probe left behind — and concurrent probes would race the counter."""
+        snapshot = self._snapshot_fn() if self._snapshot_fn is not None else None
+        verdict = classify_pipeline(self._heartbeats_fn(), snapshot,
+                                    self._stall_after_s)
+        if snapshot is not None:
+            from petastorm_tpu.workers.stats import progress_marker
+            items_out, _ = progress_marker(snapshot)
+            verdict['items_out'] = items_out
+            verdict['items_out_delta'] = items_out - self._last_items_out
+            if _advance_progress_window:
+                self._last_items_out = items_out
+        self.last_verdict = verdict
+        return verdict
+
+    # -- background thread -----------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name='petastorm-tpu-watchdog')
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop_event.wait(self._interval):
+            try:
+                verdict = self.evaluate(_advance_progress_window=True)
+            except Exception:
+                logger.exception('watchdog evaluation failed')
+                continue
+            if verdict['state'] == STALLED:
+                if not self._stall_fired:
+                    self._stall_fired = True
+                    logger.error('pipeline stalled: %s',
+                                 verdict.get('hint', verdict))
+                    if self._on_stall is not None:
+                        try:
+                            self._on_stall(verdict)
+                        except Exception:
+                            logger.exception('on_stall callback failed')
+            else:
+                self._stall_fired = False
+
+    def stop(self, join: bool = True) -> None:
+        """Signal the thread to stop; with ``join`` also wait for it.
+        Idempotent."""
+        self._stop_event.set()
+        if not join:
+            return
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=10)
+            self._thread = None
+
+
+class DebugServer:
+    """Opt-in HTTP debug endpoint over one pipeline's health surfaces.
+
+    Binds ``127.0.0.1:<port>`` (``port=0`` = ephemeral; read :attr:`port`
+    after :meth:`start`) and serves:
+
+    - ``GET /healthz`` — the watchdog verdict as JSON; status 200, or 503
+      when the pipeline is classified ``stalled`` (point a k8s liveness
+      probe at it).
+    - ``GET /metrics`` — the stats snapshot in Prometheus text-exposition
+      format (the metrics emitter's formatter).
+    - ``GET /diagnostics`` — ``{stats, heartbeats, verdict}`` as JSON.
+    - ``GET /stacks`` — plain-text stack dump of every in-process thread.
+
+    Requests are served on daemon threads (``ThreadingHTTPServer``);
+    :meth:`stop` shuts the accept loop down, closes the socket and joins the
+    server thread. Idempotent.
+    """
+
+    def __init__(self, evaluate_fn: Callable[[], dict],
+                 snapshot_fn: Optional[Callable[[], dict]] = None,
+                 heartbeats_fn: Optional[Callable[[], Dict[str, dict]]] = None,
+                 port: int = 0, prefix: str = 'petastorm_tpu'):
+        self._evaluate_fn = evaluate_fn
+        self._snapshot_fn = snapshot_fn or (lambda: {})
+        self._heartbeats_fn = heartbeats_fn or (lambda: {})
+        self._requested_port = port
+        self._prefix = prefix
+        self._server = None
+        self._thread: Optional[threading.Thread] = None
+        #: The bound port (differs from the requested one when it was 0).
+        self.port: Optional[int] = None
+
+    def start(self) -> 'DebugServer':
+        if self._server is not None:
+            return self
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet by default
+                logger.debug('debug endpoint: ' + fmt, *args)
+
+            def _reply(self, status: int, content_type: str, body: str):
+                payload = body.encode('utf-8')
+                self.send_response(status)
+                self.send_header('Content-Type', content_type)
+                self.send_header('Content-Length', str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                try:
+                    route = self.path.split('?', 1)[0].rstrip('/') or '/'
+                    if route == '/healthz':
+                        verdict = outer._evaluate_fn()
+                        status = 503 if verdict.get('state') == STALLED else 200
+                        self._reply(status, 'application/json',
+                                    json.dumps(verdict, default=str))
+                    elif route == '/metrics':
+                        from petastorm_tpu.tracing import prometheus_text
+                        self._reply(200, 'text/plain; version=0.0.4',
+                                    prometheus_text(outer._snapshot_fn(),
+                                                    prefix=outer._prefix))
+                    elif route == '/diagnostics':
+                        blob = {'verdict': outer._evaluate_fn(),
+                                'stats': outer._snapshot_fn(),
+                                'heartbeats': outer._heartbeats_fn()}
+                        self._reply(200, 'application/json',
+                                    json.dumps(blob, default=str))
+                    elif route == '/stacks':
+                        stacks = thread_stacks()
+                        body = '\n'.join('== {} ==\n{}'.format(name, stack)
+                                         for name, stack in sorted(
+                                             stacks.items()))
+                        self._reply(200, 'text/plain', body)
+                    else:
+                        self._reply(404, 'text/plain',
+                                    'unknown route {}; try /healthz /metrics '
+                                    '/diagnostics /stacks\n'.format(route))
+                except Exception as e:  # report, never kill the serve loop
+                    logger.exception('debug endpoint request failed')
+                    try:
+                        self._reply(500, 'text/plain', 'error: {}\n'.format(e))
+                    except OSError:
+                        pass
+
+        self._server = ThreadingHTTPServer(('127.0.0.1', self._requested_port),
+                                           Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        kwargs={'poll_interval': 0.1},
+                                        daemon=True,
+                                        name='petastorm-tpu-debug-http')
+        self._thread.start()
+        logger.info('petastorm_tpu debug endpoint on http://127.0.0.1:%d '
+                    '(/healthz /metrics /diagnostics /stacks)', self.port)
+        return self
+
+    def stop(self) -> None:
+        server, self._server = self._server, None
+        if server is None:
+            return
+        server.shutdown()
+        server.server_close()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10)

@@ -226,6 +226,11 @@ class JaxLoaderBase(object):
         #: gap between batches) spans into it, so the device-idle gap is
         #: visible on the same timeline as the worker stages.
         self.tracer = getattr(reader, 'tracer', None)
+        #: The reader's :class:`~petastorm_tpu.health.HealthMonitor` (None
+        #: for readers without one). Pass it to ``prefetch_to_device(...,
+        #: health=loader.health)`` so the prefetch thread heartbeats onto the
+        #: same watchdog as the rest of the pipeline.
+        self.health = getattr(reader, 'health', None)
 
     def __iter__(self):
         if self._error is not None:
@@ -655,7 +660,8 @@ def stage_to_global(batch, named_sharding, stats=None, tracer=None):
     return device
 
 
-def infeed_diagnosis(snapshot: dict) -> dict:
+def infeed_diagnosis(snapshot: dict, heartbeats=None,
+                     stall_after_s=None) -> dict:
     """Classify an infeed pipeline from a ``ReaderStats`` snapshot
     (``reader.diagnostics`` / ``loader.stats.snapshot()``) and recommend the
     knobs that attack its bottleneck.
@@ -670,40 +676,45 @@ def infeed_diagnosis(snapshot: dict) -> dict:
     - **consumer-bound** — workers outrun the consumer (large
       ``worker_publish_wait_s``): the training step, not the reader, is the
       ceiling.
+
+    ``heartbeats`` (``reader.health.heartbeats()``) optionally folds the
+    live health layer into the verdict: the returned dict gains
+    ``pipeline_state`` (healthy/degraded/stalled/starving) and
+    ``stalled_entities``, and a stalled entity overrides ``bottleneck`` with
+    ``'stalled'`` — the same :func:`petastorm_tpu.health.classify_pipeline`
+    call the watchdog and ``/healthz`` make, so the CLI's ``-d`` output and
+    the debug endpoint can never disagree. ``stall_after_s`` defaults to
+    :data:`petastorm_tpu.health.DEFAULT_STALL_AFTER_S`.
     """
-    from petastorm_tpu.workers.stats import (effective_io_s,
-                                             readahead_hit_rate,
+    from petastorm_tpu.health import (DEFAULT_STALL_AFTER_S,
+                                      bottleneck_signals, classify_pipeline)
+    from petastorm_tpu.workers.stats import (readahead_hit_rate,
                                              recommend_io_readahead)
-    io_s = effective_io_s(snapshot)
-    decode_s = snapshot.get('worker_decode_s', 0.0)
-    publish_wait_s = snapshot.get('worker_publish_wait_s', 0.0)
-    busy = io_s + decode_s
-    if publish_wait_s > busy:
-        bottleneck = 'consumer'
-        hint = ('workers outrun the consumer (publish_wait > io+decode): '
-                'the training step / consumer loop is the ceiling')
-    elif io_s > decode_s * 1.5:
-        bottleneck = 'io'
-        hint = ('storage stall dominates: raise io_readahead (or pass '
-                "io_readahead='auto') before raising workers_count")
-    elif decode_s > io_s * 1.5:
-        bottleneck = 'decode'
-        hint = ('decode dominates and reads are hidden: raise workers_count '
-                'or cut decode work (decode_hints, lighter transforms)')
-    else:
-        bottleneck = 'balanced'
-        hint = ('io and decode are comparable: io_readahead overlaps them '
-                'for up to 2x; workers_count scales both')
-    return {
-        'bottleneck': bottleneck,
+    signals = bottleneck_signals(snapshot)
+    io_s, decode_s = signals['io_s'], signals['decode_s']
+    out = {
+        'bottleneck': signals['bottleneck'],
         'io_s': round(io_s, 4),
         'decode_s': round(decode_s, 4),
         'io_decode_ratio': round(io_s / decode_s, 3) if decode_s else None,
         'io_overlap_fraction': snapshot.get('io_overlap_fraction', 0.0),
         'readahead_hit_rate': readahead_hit_rate(snapshot),
         'recommended_io_readahead': recommend_io_readahead(snapshot),
-        'hint': hint,
+        'hint': signals['hint'],
     }
+    if heartbeats is not None:
+        verdict = classify_pipeline(
+            heartbeats, snapshot,
+            DEFAULT_STALL_AFTER_S if stall_after_s is None else stall_after_s)
+        out['pipeline_state'] = verdict['state']
+        out['stalled_entities'] = verdict['stalled_entities']
+        if verdict['state'] == 'stalled':
+            # a wedged entity trumps any aggregate signal: time sums stop
+            # moving the moment the stall starts, so the ratios describe the
+            # past, not the problem
+            out['bottleneck'] = 'stalled'
+            out['hint'] = verdict['hint']
+    return out
 
 
 def make_jax_loader(reader, batch_size=1, mesh=None, batch_axis='data',
@@ -764,10 +775,12 @@ def epoch_cache_on_device(loader, sharding=None):
             yield batch
 
 
-def prefetch_batches(iterator, size=2):
+def prefetch_batches(iterator, size=2, health=None):
     """Host-side lookahead WITHOUT device staging: a background thread keeps
     up to ``size`` numpy batches ready; the jitted step's own call performs
-    the host→device transfer.
+    the host→device transfer. ``health`` (a
+    :class:`~petastorm_tpu.health.HealthMonitor`, e.g. ``reader.health``)
+    lets the prefetch thread publish liveness heartbeats.
 
     When to use which prefetcher: :func:`prefetch_to_device` issues an
     explicit ``jax.device_put`` per batch, overlapping the H2D DMA with
@@ -778,11 +791,11 @@ def prefetch_batches(iterator, size=2):
     dispatch. Measured on a v5e LM bench (64×257 int32 batches, ~1ms steps):
     86-90% infeed overlap via ``prefetch_to_device`` vs ~99% via
     ``prefetch_batches``."""
-    return _pipeline(iterator, size, lambda batch: batch)
+    return _pipeline(iterator, size, lambda batch: batch, health=health)
 
 
 def prefetch_to_device(iterator, size=2, sharding=None, stats=None,
-                       tracer=None):
+                       tracer=None, health=None):
     """Double-buffered host→device prefetch.
 
     Stages up to ``size`` batches ahead of the consumer on a background thread
@@ -801,6 +814,10 @@ def prefetch_to_device(iterator, size=2, sharding=None, stats=None,
         each transfer dispatch as a ``device_stage`` span — the prefetch
         thread gets its own track, so the overlap with the consumer's
         ``train_step`` spans is visible directly.
+    :param health: optional :class:`~petastorm_tpu.health.HealthMonitor`
+        (e.g. ``reader.health`` / ``loader.health``); the prefetch thread
+        publishes a ``loader-prefetch`` heartbeat entity so the watchdog can
+        tell a wedged device transfer from a starving reader.
     """
     import jax
 
@@ -826,32 +843,43 @@ def prefetch_to_device(iterator, size=2, sharding=None, stats=None,
                 tracer.add_span('device_stage', 'device', start, elapsed)
         return staged
 
-    return _pipeline(iterator, size, put)
+    return _pipeline(iterator, size, put, health=health)
 
 
-def _pipeline(iterator, size, put):
+def _pipeline(iterator, size, put, health=None):
     """Shared producer-thread pipeline behind the two prefetchers."""
     queue = collections.deque()
     done = object()
     cv = threading.Condition()
     state = {'error': None, 'finished': False}
+    beat = health.beat if health is not None else None
 
     def producer():
         try:
             for batch in iterator:
                 if state['finished']:   # consumer closed early: stop reading
                     return
+                if beat is not None:
+                    beat('loader-prefetch', 'staging')
                 staged = put(batch)
                 with cv:
+                    if beat is not None and len(queue) >= size:
+                        # blocked on a full prefetch queue = the consumer is
+                        # the slow side; idle-class, never a prefetch stall
+                        beat('loader-prefetch', 'backpressured')
                     while len(queue) >= size and not state['finished']:
                         cv.wait()
                     if state['finished']:
                         return
                     queue.append(staged)
                     cv.notify_all()
+                if beat is not None:
+                    beat('loader-prefetch', 'idle')
         except Exception as e:  # propagate into the consumer
             state['error'] = e
         finally:
+            if beat is not None:
+                beat('loader-prefetch', 'done')
             with cv:
                 queue.append(done)
                 cv.notify_all()

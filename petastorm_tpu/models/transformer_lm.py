@@ -215,7 +215,9 @@ def _ring_attention_sharded(q, k, v, mesh):
     from petastorm_tpu.parallel.ring import resolve_ring_impl
     impl = resolve_ring_impl(None, mesh)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    from petastorm_tpu.parallel.mesh import shard_map_fn
+
+    @functools.partial(shard_map_fn(), mesh=mesh,
                        in_specs=(spec, spec, spec), out_specs=spec)
     def fn(q, k, v):
         return ring_attention(q, k, v, 'seq', causal=True, impl=impl)

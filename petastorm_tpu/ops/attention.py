@@ -19,6 +19,16 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+
+def _tpu_compiler_params(pltpu, **kwargs):
+    """``pltpu.CompilerParams`` across jax versions (the 0.4.x line spells
+    it ``TPUCompilerParams``); one resolution point for every pallas_call."""
+    cls = getattr(pltpu, 'CompilerParams', None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
 _NEG_INF = -1e30
 
 
@@ -539,7 +549,8 @@ def _pallas_flash(q, k, v, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((bq, 128), jnp.float32),        # running max (lanes equal)
             pltpu.VMEM((bq, 128), jnp.float32),        # running sum (lanes equal)
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
+            pltpu,
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(*inputs)
@@ -824,7 +835,8 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
         out_specs=qspec,
         out_shape=_sds((flat, pq_len, head_dim), qf.dtype, vma),
         scratch_shapes=[pltpu.VMEM((bq, head_dim), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
+            pltpu,
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(*dq_inputs)
@@ -858,7 +870,8 @@ def _flash_backward_from_prepared(dims: '_FlashDims', prep, k, v, *,
                    _sds((flat, pk_len, head_dim), part_dtypes[1], vma)],
         scratch_shapes=[pltpu.VMEM((bk, head_dim), jnp.float32),
                         pltpu.VMEM((bk, head_dim), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
+            pltpu,
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(*dkdv_inputs)

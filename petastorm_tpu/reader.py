@@ -19,6 +19,8 @@ TPU-first deviations:
 from __future__ import annotations
 
 import logging
+import os
+import time
 from typing import List, Optional
 
 from petastorm_tpu.cache import LocalDiskCache, NullCache
@@ -30,6 +32,10 @@ from petastorm_tpu.filters import (FiltersPredicate, RowGroupStatsEvaluator,
                                    filter_column_names, normalize_filters,
                                    validate_filter_types)
 from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dataset_url_or_urls
+from petastorm_tpu.health import (DEFAULT_STALL_AFTER_S, DebugServer,
+                                  HealthMonitor, PipelineWatchdog,
+                                  build_flight_record, resolve_debug_port,
+                                  write_flight_record)
 from petastorm_tpu.ngram import NGram
 from petastorm_tpu.predicates import in_reduce
 from petastorm_tpu.readers.batch_worker import ArrowBatchWorker, BatchResultsReader
@@ -149,7 +155,8 @@ def make_reader(dataset_url,
                 storage_options=None, zmq_copy_buffers=True,
                 profiling_enabled=False, decode_hints=None,
                 io_readahead=0, trace=None, metrics_interval=0,
-                metrics_out=None):
+                metrics_out=None, debug_port=None, stall_timeout=0,
+                flight_record_dir=None):
     """Row-granular reader for petastorm_tpu datasets (codec-decoded rows).
 
     Mirrors the reference factory (``reader.py:61-195``). Raises a helpful error
@@ -173,6 +180,14 @@ def make_reader(dataset_url,
     background emitter snapshotting the reader's stats every N seconds into
     ``metrics_out`` (JSON-lines, or Prometheus text for ``.prom`` paths).
     See ``docs/tracing.md``.
+
+    ``debug_port=N`` (or ``PETASTORM_TPU_DEBUG_PORT``) serves the live
+    health endpoints on ``127.0.0.1:N`` (``/healthz`` ``/metrics``
+    ``/diagnostics`` ``/stacks``; ``0`` = ephemeral, read
+    ``reader.debug_port``); ``stall_timeout=S`` arms a background watchdog
+    that classifies the pipeline from per-entity heartbeats and writes a
+    flight-recorder JSON into ``flight_record_dir`` when no entity has made
+    progress for S seconds. See ``docs/health.md``.
     """
     dataset_url = normalize_dataset_url_or_urls(dataset_url)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url, storage_options)
@@ -206,7 +221,9 @@ def make_reader(dataset_url,
                   cache=cache, transform_spec=transform_spec, filters=filters,
                   pool=pool, is_batched_reader=False, decode_hints=decode_hints,
                   io_readahead=io_readahead, trace_export=trace_export,
-                  metrics_interval=metrics_interval, metrics_out=metrics_out)
+                  metrics_interval=metrics_interval, metrics_out=metrics_out,
+                  debug_port=debug_port, stall_timeout=stall_timeout,
+                  flight_record_dir=flight_record_dir)
 
 
 def make_columnar_reader(dataset_url,
@@ -224,7 +241,8 @@ def make_columnar_reader(dataset_url,
                          storage_options=None, zmq_copy_buffers=True,
                          profiling_enabled=False, decode_hints=None,
                          io_readahead=0, trace=None, metrics_interval=0,
-                         metrics_out=None):
+                         metrics_out=None, debug_port=None, stall_timeout=0,
+                         flight_record_dir=None):
     """Vectorized codec-decoded reader for petastorm_tpu datasets.
 
     Yields **batch namedtuples of decoded numpy column arrays** (one per row
@@ -273,7 +291,9 @@ def make_columnar_reader(dataset_url,
                   cache=cache, transform_spec=transform_spec, filters=filters,
                   pool=pool, is_batched_reader=True, decode_hints=decode_hints,
                   io_readahead=io_readahead, trace_export=trace_export,
-                  metrics_interval=metrics_interval, metrics_out=metrics_out)
+                  metrics_interval=metrics_interval, metrics_out=metrics_out,
+                  debug_port=debug_port, stall_timeout=stall_timeout,
+                  flight_record_dir=flight_record_dir)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -288,12 +308,14 @@ def make_batch_reader(dataset_url_or_urls,
                       transform_spec=None, filters=None,
                       storage_options=None, zmq_copy_buffers=True,
                       profiling_enabled=False, io_readahead=0, trace=None,
-                      metrics_interval=0, metrics_out=None):
+                      metrics_interval=0, metrics_out=None, debug_port=None,
+                      stall_timeout=0, flight_record_dir=None):
     """Vectorized batch reader for arbitrary parquet stores
     (reference ``reader.py:198-327``). Yields namedtuples of column arrays,
     one per row group. ``io_readahead`` prefetches upcoming row-group reads
     per worker; ``trace``/``metrics_interval``/``metrics_out`` enable the
-    span tracer and metrics emitter (see :func:`make_reader`)."""
+    span tracer and metrics emitter; ``debug_port``/``stall_timeout``/
+    ``flight_record_dir`` the live health layer (see :func:`make_reader`)."""
     dataset_url_or_urls = normalize_dataset_url_or_urls(dataset_url_or_urls)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url_or_urls,
                                                          storage_options)
@@ -320,7 +342,9 @@ def make_batch_reader(dataset_url_or_urls,
                   cache=cache, transform_spec=transform_spec, filters=filters,
                   pool=pool, is_batched_reader=True, io_readahead=io_readahead,
                   trace_export=trace_export, metrics_interval=metrics_interval,
-                  metrics_out=metrics_out)
+                  metrics_out=metrics_out, debug_port=debug_port,
+                  stall_timeout=stall_timeout,
+                  flight_record_dir=flight_record_dir)
 
 
 class Reader:
@@ -334,7 +358,8 @@ class Reader:
                  cache=None, transform_spec=None, filters=None,
                  pool=None, is_batched_reader=False, decode_hints=None,
                  io_readahead=0, trace_export=None, metrics_interval=0,
-                 metrics_out=None):
+                 metrics_out=None, debug_port=None, stall_timeout=0,
+                 flight_record_dir=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -346,6 +371,9 @@ class Reader:
         if metrics_interval and not metrics_out:
             raise ValueError('metrics_interval needs a metrics_out path to '
                              'emit snapshots into')
+        if stall_timeout and stall_timeout < 0:
+            raise ValueError('stall_timeout must be >= 0, got '
+                             '{!r}'.format(stall_timeout))
         self._filesystem_factory = filesystem_factory
         self._dataset_path = dataset_path
         self._pool = pool
@@ -353,7 +381,16 @@ class Reader:
         self._num_epochs = num_epochs
         self._trace_export = trace_export
         self._metrics_emitter = None
+        self._watchdog = None
+        self._debug_server = None
+        self._flight_record_dir = flight_record_dir
         self.last_row_consumed = False
+        #: The pipeline's :class:`~petastorm_tpu.health.HealthMonitor`:
+        #: per-entity heartbeats from the ventilator, the pool's workers
+        #: (plus their readahead threads), and — when wired via
+        #: ``prefetch_to_device(..., health=...)`` — the loader's prefetch
+        #: thread. ``reader.health.heartbeats()`` is the live record set.
+        self.health = HealthMonitor()
 
         filesystem = filesystem_factory()
         stored_schema, _ = infer_or_load_unischema(filesystem, dataset_path)
@@ -460,10 +497,12 @@ class Reader:
             ventilate_fn, items, iterations=num_epochs,
             randomize_item_order=shuffle_row_groups, random_seed=seed,
             max_ventilation_queue_size=(
-                pool.workers_count * (1 + lookahead) + _VENTILATE_EXTRA_ROWGROUPS))
+                pool.workers_count * (1 + lookahead) + _VENTILATE_EXTRA_ROWGROUPS),
+            heartbeat=self.health.beat if self.health.enabled else None)
 
         worker_args = {
             'trace': tracer is not None,
+            'health': self.health.enabled,
             'filesystem_factory': filesystem_factory,
             'dataset_path': dataset_path,
             'schema': view_schema,
@@ -483,6 +522,38 @@ class Reader:
             self._metrics_emitter = MetricsEmitter(
                 pool.stats.snapshot, metrics_interval, metrics_out)
             self._metrics_emitter.start()
+
+        # -- live health layer (see docs/health.md) ---------------------------
+        pool_heartbeats = getattr(pool, 'heartbeats', None)
+        if pool_heartbeats is not None:
+            self.health.add_source(pool_heartbeats)
+        resolved_debug_port = resolve_debug_port(debug_port)
+        if stall_timeout or resolved_debug_port is not None:
+            # on-demand verdicts (/healthz) use the default threshold when no
+            # stall_timeout was configured; the background thread only runs
+            # when one was (it exists to fire the flight recorder)
+            self._watchdog = PipelineWatchdog(
+                self.health.heartbeats, pool.stats.snapshot,
+                stall_after_s=stall_timeout or DEFAULT_STALL_AFTER_S,
+                on_stall=self._on_stall)
+            if stall_timeout:
+                self._watchdog.start()
+        if resolved_debug_port is not None:
+            self._debug_server = DebugServer(
+                self._watchdog.evaluate, pool.stats.snapshot,
+                self.health.heartbeats, port=resolved_debug_port)
+            try:
+                self._debug_server.start()
+            except (OSError, OverflowError) as e:   # taken / out-of-range port
+                # A taken port must not kill the pipeline it observes: with
+                # PETASTORM_TPU_DEBUG_PORT set job-wide, the SECOND reader in
+                # the job would otherwise crash at construction. The watchdog
+                # stays armed; only this reader's endpoint is missing.
+                logger.warning(
+                    'debug endpoint disabled: could not bind 127.0.0.1:%d '
+                    '(%s); pass debug_port=0 for an ephemeral port per '
+                    'reader', resolved_debug_port, e)
+                self._debug_server = None
         self._results_reader = results_reader_factory(transformed_schema, self.ngram)
         self._stopped = False
         #: True when every published NGram item is a columnar
@@ -640,20 +711,78 @@ class Reader:
         self._ventilator.reset(self._num_epochs)
         self.last_row_consumed = False
 
+    # -- flight recorder -------------------------------------------------------
+
+    def _on_stall(self, verdict):
+        try:
+            path = self.dump_flight_record(verdict=verdict)
+            logger.error('pipeline stalled; flight record written to %s', path)
+        except Exception:
+            logger.exception('failed to write flight record')
+
+    def dump_flight_record(self, path=None, verdict=None):
+        """Write a flight-recorder JSON (heartbeats, stats snapshot, queue
+        occupancy, per-thread stacks, span ring tail when tracing is on) and
+        return its path. The watchdog calls this automatically on a stall;
+        call it directly for an on-demand dump. ``path=None`` names a file
+        in ``flight_record_dir`` (or the system temp dir)."""
+        if verdict is None:
+            if self._watchdog is not None:
+                verdict = self._watchdog.evaluate()
+            else:
+                from petastorm_tpu.health import classify_pipeline
+                verdict = classify_pipeline(self.health.heartbeats(),
+                                            self._pool.stats.snapshot())
+        snapshot = self._pool.stats.snapshot()
+        queues = {
+            'queue_depth': snapshot.get('queue_depth', 0),
+            'queue_depth_max': snapshot.get('queue_depth_max', 0),
+            'shuffle_buffer_depth': snapshot.get('shuffle_buffer_depth', 0),
+            'readahead_depth': snapshot.get('readahead_depth', 0),
+        }
+        record = build_flight_record(verdict, self.health.heartbeats(),
+                                     snapshot, queues, tracer=self.tracer)
+        if path is None:
+            import tempfile
+            out_dir = self._flight_record_dir or tempfile.gettempdir()
+            path = os.path.join(out_dir, 'petastorm_tpu_flight_{}_{}.json'
+                                .format(os.getpid(), int(time.time())))
+        return write_flight_record(path, record)
+
     # -- lifecycle -------------------------------------------------------------
 
     def stop(self):
+        """Stop the pipeline. Idempotent, and ordered so the health layer
+        (watchdog, emitter) is signalled even when the pool below died
+        uncleanly: an unclean pool must never leave monitoring threads
+        running against a corpse."""
         self._stopped = True
         if self._metrics_emitter is not None:
             self._metrics_emitter.stop(join=False)
-        self._pool.stop()
+        if self._watchdog is not None:
+            self._watchdog.stop(join=False)
+        try:
+            self._pool.stop()
+        finally:
+            if self._debug_server is not None:
+                self._debug_server.stop()
 
     def join(self):
-        self._pool.join()
-        if self._metrics_emitter is not None:
-            # joins the emitter thread and writes one final snapshot, so even
-            # sub-interval runs record at least one sample
-            self._metrics_emitter.stop()
+        """Join every pipeline thread: the pool, then the metrics emitter,
+        watchdog and debug server (all with bounded joins). Idempotent —
+        every stop below tolerates being called again — so teardown paths
+        that cannot know whether an earlier join ran may call it anyway."""
+        try:
+            self._pool.join()
+        finally:
+            if self._metrics_emitter is not None:
+                # joins the emitter thread and writes one final snapshot, so
+                # even sub-interval runs record at least one sample
+                self._metrics_emitter.stop()
+            if self._watchdog is not None:
+                self._watchdog.stop()
+            if self._debug_server is not None:
+                self._debug_server.stop()
         if self._trace_export and self.tracer is not None:
             try:
                 self.tracer.export_chrome_trace(self._trace_export)
@@ -677,6 +806,20 @@ class Reader:
         the live per-stage telemetry accumulator. The JAX loaders record
         device staging time into it; ``diagnostics`` snapshots it."""
         return getattr(self._pool, 'stats', None)
+
+    @property
+    def watchdog(self):
+        """The reader's :class:`~petastorm_tpu.health.PipelineWatchdog`
+        (``None`` unless built with ``stall_timeout=`` or ``debug_port=``).
+        ``reader.watchdog.evaluate()`` classifies the pipeline right now."""
+        return self._watchdog
+
+    @property
+    def debug_port(self):
+        """The bound port of the HTTP debug endpoint (``None`` when no
+        server runs; differs from the requested port when that was 0)."""
+        return self._debug_server.port if self._debug_server is not None \
+            else None
 
     @property
     def tracer(self):

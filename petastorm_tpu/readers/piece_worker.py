@@ -127,8 +127,14 @@ class ParquetPieceWorker(WorkerBase):
             # the background thread gets its own handle cache: a ParquetFile
             # must never serve two concurrent reads
             self._prefetch_files = FileHandleCache(self._open_parquet)
-            self._readahead = RowGroupReadahead(self._readahead_read, depth,
-                                                trace=self.tracing_enabled)
+            # the background reader thread publishes its own heartbeat
+            # entity next to the worker's (a wedged prefetch read must be
+            # attributable to the readahead thread, not the worker)
+            readahead_entity = 'readahead-{}'.format(worker_id)
+            self._readahead = RowGroupReadahead(
+                self._readahead_read, depth, trace=self.tracing_enabled,
+                beat=(lambda stage: self.beat_entity(readahead_entity, stage))
+                if self.health_enabled else None)
 
     def shutdown(self):
         if self._readahead is not None:
@@ -220,6 +226,9 @@ class ParquetPieceWorker(WorkerBase):
         readahead enabled, prefetched reads are consumed here (only the
         blocked wait, if any, lands in ``worker_io_s``); unplanned reads fall
         back inline."""
+        # entry beat: a read that never returns must be attributed to ``io``
+        # (the completion beat inside record_time can only fire afterwards)
+        self.beat('io')
         if self._readahead is not None:
             table = self._readahead.take(self._read_key(piece, columns))
             self._readahead.drain_stats_into(self)
@@ -239,6 +248,7 @@ class ParquetPieceWorker(WorkerBase):
         typed, honoring per-field decode overrides) — the one columnar decode
         shared by the columnar worker and the row worker's window path."""
         from petastorm_tpu.readers.columnar_worker import _column_to_numpy
+        self.beat('decode')   # entry beat: a wedged codec shows as `decode`
         start = time.perf_counter()
         out = {}
         for name in names:

@@ -78,9 +78,14 @@ class RowGroupReadahead:
     :param trace: record a ``readahead_read`` span per background read
         (stamped with the background thread's track, drained into the worker
         alongside the stats).
+    :param beat: optional ``beat(stage)`` callable publishing the background
+        reader thread's liveness (the owning worker routes it to its own
+        heartbeat records as a ``readahead-<id>`` entity; see
+        :mod:`petastorm_tpu.health`). Called from the background thread —
+        must be cross-thread safe (``WorkerBase.beat_entity`` is).
     """
 
-    def __init__(self, read_fn, depth, trace: bool = False):
+    def __init__(self, read_fn, depth, trace: bool = False, beat=None):
         if depth != 'auto' and (not isinstance(depth, int) or depth < 1):
             raise ValueError(
                 "readahead depth must be a positive int or 'auto', got "
@@ -89,6 +94,7 @@ class RowGroupReadahead:
         self._auto = depth == 'auto'
         self._depth = AUTO_INITIAL_DEPTH if self._auto else depth
         self._trace = trace
+        self._beat = beat
         self._lock = threading.Lock()
         self._scheduled: deque = deque()      # FIFO of un-consumed _Prefetch
         self._requests: queue.Queue = queue.Queue()
@@ -242,13 +248,20 @@ class RowGroupReadahead:
     # -- background thread -----------------------------------------------------
 
     def _reader_loop(self) -> None:
+        beat = self._beat
         while True:
+            if beat is not None:
+                beat('idle')
             entry = self._requests.get()
             if entry is None:
+                if beat is not None:
+                    beat('stopped')
                 return
             if entry.cancelled:
                 entry.done.set()
                 continue
+            if beat is not None:
+                beat('io')
             start = time.perf_counter()
             try:
                 entry.table = self._read_fn(entry.piece, entry.columns)

@@ -1,6 +1,9 @@
 """Trivial workers used by pool tests (importable from spawned worker
 interpreters, unlike classes defined inside test modules)."""
 
+import os
+import time
+
 import numpy as np
 
 from petastorm_tpu.workers.worker_base import WorkerBase
@@ -35,3 +38,38 @@ class ArrayWorker(WorkerBase):
 
     def process(self, n):
         self.publish_func(np.full((n,), n, dtype=np.int64))
+
+
+class WedgeWorker(WorkerBase):
+    """Wedges mid-item on the designated poison value — the stall-injection
+    fixture for watchdog/flight-recorder tests.
+
+    The wedge beats ``decode`` and then blocks on an event gate until
+    released: ``args['wedge_event']`` (a ``threading.Event``, in-process
+    pools) or — the cross-process form of the same gate — the appearance of
+    ``args['release_file']`` on disk (process pools; polled every 10 ms).
+    ``args['max_wait_s']`` (default 60) bounds the wedge so a broken test
+    can never hang CI. Non-poison items publish straight through.
+    """
+
+    def process(self, x):
+        if x == self.args['wedge_on']:
+            self.beat('decode')
+            event = self.args.get('wedge_event')
+            release_file = self.args.get('release_file')
+            deadline = time.monotonic() + self.args.get('max_wait_s', 60)
+            while time.monotonic() < deadline:
+                if event is not None and event.wait(timeout=0.01):
+                    break
+                if release_file is not None and os.path.exists(release_file):
+                    break
+                if event is None and release_file is None:
+                    raise ValueError('WedgeWorker needs wedge_event or '
+                                     'release_file')
+                if event is not None:
+                    continue
+                time.sleep(0.01)
+            else:
+                raise RuntimeError('WedgeWorker was never released within '
+                                   '{}s'.format(self.args.get('max_wait_s', 60)))
+        self.publish_func(x)

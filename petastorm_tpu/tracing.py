@@ -199,6 +199,37 @@ class Tracer:
             json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
         return sum(1 for e in events if e['ph'] == 'X')
 
+    def tail(self, limit: int = 500) -> List[dict]:
+        """The most recent ``limit`` spans as JSON-able dicts (same field
+        names as the chrome events: ``ts``/``dur`` in microseconds). The
+        flight recorder embeds this ring tail in its stall dump — the last
+        thing the pipeline did before it stopped doing anything."""
+        if limit < 1:
+            return []
+        with self._lock:
+            spans = list(self._spans)[-limit:]
+        return [{'name': name, 'cat': cat or 'pipeline',
+                 'ts': start_s * 1e6, 'dur': max(0.0, dur_s) * 1e6,
+                 'pid': pid, 'tid': tid, 'args': args}
+                for name, cat, start_s, dur_s, pid, tid, args in spans]
+
+
+def prometheus_text(snapshot: dict, prefix: str = 'petastorm_tpu') -> str:
+    """A stats snapshot in Prometheus text-exposition format — the one
+    formatter shared by :class:`MetricsEmitter` (``.prom`` textfile
+    collector output) and the debug endpoint's ``/metrics`` route.
+    Non-numeric values are skipped; everything is exposed as a gauge (the
+    snapshot is a point-in-time scrape, not a counter stream)."""
+    lines = []
+    for key in sorted(snapshot):
+        value = snapshot[key]
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        metric = '{}_{}'.format(prefix, key)
+        lines.append('# TYPE {} gauge'.format(metric))
+        lines.append('{} {}'.format(metric, float(value)))
+    return '\n'.join(lines) + '\n'
+
 
 class MetricsEmitter:
     """Background thread snapshotting a stats source every ``interval_s``
@@ -262,17 +293,9 @@ class MetricsEmitter:
             self.emit_count += 1
 
     def _write_prometheus(self, snapshot: dict) -> None:
-        lines = []
-        for key in sorted(snapshot):
-            value = snapshot[key]
-            if not isinstance(value, (int, float)) or isinstance(value, bool):
-                continue
-            metric = '{}_{}'.format(self._prefix, key)
-            lines.append('# TYPE {} gauge'.format(metric))
-            lines.append('{} {}'.format(metric, float(value)))
         tmp = '{}.tmp.{}'.format(self._path, os.getpid())
         with open(tmp, 'w') as f:
-            f.write('\n'.join(lines) + '\n')
+            f.write(prometheus_text(snapshot, self._prefix))
         os.replace(tmp, self._path)
 
     def stop(self, join: bool = True) -> None:

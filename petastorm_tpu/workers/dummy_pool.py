@@ -45,9 +45,15 @@ class DummyPool:
                 return self._results_queue.popleft()
             if self._work_queue:
                 args, kwargs = self._work_queue.popleft()
+                beat = getattr(self._worker, 'beat', None)
+                if beat is not None:
+                    beat('processing')
                 start = time.perf_counter()
                 self._worker.process(*args, **kwargs)
                 elapsed = time.perf_counter() - start
+                item_done = getattr(self._worker, 'item_done', None)
+                if item_done is not None:
+                    item_done()
                 times = self._worker.drain_stage_times() \
                     if hasattr(self._worker, 'drain_stage_times') else {}
                 self.stats.merge_times(finalize_item_times(times, elapsed))
@@ -75,6 +81,11 @@ class DummyPool:
     def join(self):
         if self._worker is not None:
             self._worker.shutdown()
+
+    def heartbeats(self):
+        """Live heartbeat records of the single in-process worker."""
+        snapshot = getattr(self._worker, 'heartbeat_snapshot', None)
+        return snapshot() if snapshot is not None else {}
 
     @property
     def diagnostics(self):

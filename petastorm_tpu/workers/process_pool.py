@@ -42,6 +42,11 @@ logger = logging.getLogger(__name__)
 
 _STARTUP_TIMEOUT_S = 60
 _SHUTDOWN_TIMEOUT_S = 10
+
+#: Default period of the worker-side liveness frame (override via
+#: ``worker_args['heartbeat_interval_s']``). Low-frequency by design: it
+#: exists for items that take minutes, not as a telemetry channel.
+_HEARTBEAT_INTERVAL_S = 2.0
 _LOCALHOST = 'tcp://127.0.0.1'
 
 # Control markers travelling in the second multipart frame.
@@ -68,6 +73,19 @@ class _WorkerError:
     def __init__(self, exc, formatted):
         self.exc = exc
         self.formatted = formatted
+
+
+class _WorkerHeartbeat:
+    """Low-frequency liveness frame: a worker's current heartbeat records,
+    sent every ``heartbeat_interval_s`` from a dedicated socket so an item
+    that legitimately takes minutes still beats (the per-item piggyback in
+    the accounting message only fires when an item *completes*)."""
+
+    __slots__ = ('worker_id', 'records')
+
+    def __init__(self, worker_id, records):
+        self.worker_id = worker_id
+        self.records = records
 
 
 class ProcessPool:
@@ -101,6 +119,15 @@ class ProcessPool:
         self._results_produced = 0
         self._terminated_workers = 0
         self.stats = ReaderStats()
+        # Worker heartbeat records, refreshed from the per-item accounting
+        # messages and the low-frequency _WorkerHeartbeat frames (both drain
+        # through get_results on the consumer thread); read by the watchdog.
+        # _last_drain marks the newest point records can be trusted up to:
+        # a consumer that stops polling stops observing, and heartbeats()
+        # must not let unobserved records age into false stalls.
+        self._hb_lock = threading.Lock()
+        self._heartbeats = {}
+        self._last_drain = time.perf_counter()
 
     @property
     def workers_count(self) -> int:
@@ -197,7 +224,10 @@ class ProcessPool:
                     'No results after {:.1f}s'.format(timeout))
             wait_start = time.perf_counter()
             ready = dict(self._poller.poll(100))
-            self.stats.add_time('queue_wait_s', time.perf_counter() - wait_start)
+            now = time.perf_counter()
+            self.stats.add_time('queue_wait_s', now - wait_start)
+            with self._hb_lock:
+                self._last_drain = now
             if not ready:
                 if self._all_work_consumed():
                     raise EmptyResultError()
@@ -223,6 +253,9 @@ class ProcessPool:
                 sys.stderr.write(control.formatted)
                 self.stop()
                 raise control.exc
+            if isinstance(control, _WorkerHeartbeat):
+                self._merge_heartbeats(control.records)
+                continue
             if control == _DATA:
                 with self._accounting_lock:
                     self._results_produced += 1
@@ -249,12 +282,38 @@ class ProcessPool:
                 return result
             # _WorkerStarted duplicates / stray messages are ignored.
 
+    def _merge_heartbeats(self, records):
+        if not records:
+            return
+        with self._hb_lock:
+            self._heartbeats.update(records)
+
+    def heartbeats(self):
+        """Latest heartbeat records shipped back by the worker interpreters.
+        Fresh as of the last drained accounting/heartbeat frame — the
+        consumer's ``get_results`` poll loop keeps draining while it waits,
+        so records stay live even when no item completes.
+
+        Record ages are clamped to the last drain point: when the CONSUMER
+        stops polling (a long train step, a checkpoint pause), shipped
+        records stop refreshing through no fault of the workers, so each
+        record is reported at the age it had when last observed. A wedged
+        worker resumes aging the moment the consumer polls again."""
+        with self._hb_lock:
+            records = dict(self._heartbeats)
+            gap = max(0.0, time.perf_counter() - self._last_drain)
+        if not gap:
+            return records
+        return {entity: dict(record, ts=record.get('ts', 0.0) + gap)
+                for entity, record in records.items()}
+
     def _merge_item_stats(self, item_stats):
         if not item_stats:
             return
         self.stats.merge_times(item_stats.get('times'))
         self.stats.merge_counts(item_stats.get('counts'))
         self.stats.merge_gauges(item_stats.get('gauges'))
+        self._merge_heartbeats(item_stats.get('heartbeats'))
         if self.tracer is not None:
             self.tracer.merge(item_stats.get('spans'))
         for counter in ('payload_copies',):
@@ -361,6 +420,11 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
     item_spans = []
     trace_pid = os.getpid()
 
+    # set once the worker exists: lets send() mark time blocked on a full
+    # results socket as idle-class back-pressure (a slow/paused consumer,
+    # not a wedged worker — same exemption as ThreadPool._put_result)
+    publish_beat = {'fn': None}
+
     def send(payload_frames, control):
         message = [payload_frames[0], pickle.dumps(control)] + list(payload_frames[1:])
         # Zero-copy send for large payloads: libzmq reads the buffers in
@@ -369,7 +433,16 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
         # plain copying path.
         nocopy = sum(_nbytes(f) for f in payload_frames) >= _ZMQ_NOCOPY_SEND_THRESHOLD
         start = time.perf_counter()
-        results_sender.send_multipart(message, copy=not nocopy)
+        try:
+            results_sender.send_multipart(message, copy=not nocopy,
+                                          flags=zmq.NOBLOCK)
+        except zmq.Again:   # HWM reached: the consumer is the slow side
+            beat = publish_beat['fn']
+            if beat is not None:
+                beat('backpressured')
+            results_sender.send_multipart(message, copy=not nocopy)
+            if beat is not None:
+                beat('processing')
         item['publish_wait_s'] += time.perf_counter() - start
 
     def publish(data):
@@ -389,6 +462,52 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
         send([b''], _WorkerError(e, traceback.format_exc()))
         return
     send([b''], _WorkerStarted(worker_id))
+
+    # Low-frequency liveness frames: the accounting message only carries a
+    # heartbeat when an item COMPLETES, so a legitimate minutes-long item
+    # (or a wedged one — the case the watchdog exists for) would look dead.
+    # A dedicated thread ships the worker's current records every interval.
+    # ZMQ sockets are not thread-safe: this thread owns its own PUSH socket
+    # (contexts are shareable, sockets are not) and closes it itself so the
+    # final context.term() cannot hang on it.
+    hb_stop = threading.Event()
+    hb_thread = None
+    hb_snapshot = getattr(worker, 'heartbeat_snapshot', None)
+    health_on = not (isinstance(worker_args, dict)
+                     and worker_args.get('health') is False)
+    if health_on:
+        publish_beat['fn'] = getattr(worker, 'beat', None)
+    if health_on and hb_snapshot is not None:
+        hb_interval = (worker_args.get('heartbeat_interval_s',
+                                       _HEARTBEAT_INTERVAL_S)
+                       if isinstance(worker_args, dict)
+                       else _HEARTBEAT_INTERVAL_S)
+
+        def hb_loop():
+            sock = context.socket(zmq.PUSH)
+            sock.connect(results_addr)
+            try:
+                while not hb_stop.wait(hb_interval):
+                    try:
+                        # NOBLOCK: a blocking send with the consumer gone or
+                        # not draining would be uninterruptible by hb_stop,
+                        # leaving the socket open and wedging context.term()
+                        # at worker exit. Dropping a liveness frame is free —
+                        # the next tick carries fresher records anyway.
+                        sock.send_multipart(
+                            [b'', pickle.dumps(_WorkerHeartbeat(
+                                worker_id, hb_snapshot()))],
+                            flags=zmq.NOBLOCK)
+                    except zmq.Again:
+                        continue
+            except zmq.ZMQError:
+                pass   # pool tearing down under us
+            finally:
+                sock.close(linger=0)
+
+        hb_thread = threading.Thread(target=hb_loop, daemon=True,
+                                     name='petastorm-tpu-worker-heartbeat')
+        hb_thread.start()
 
     poller = zmq.Poller()
     poller.register(work_receiver, zmq.POLLIN)
@@ -425,6 +544,8 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
                 # outstanding reads as a prefix of this list)
                 hint(list(pending))
             args, kwargs = pending.popleft()
+            if health_on and hasattr(worker, 'beat'):
+                worker.beat('processing')
             item['serialize_s'] = 0.0
             item['publish_wait_s'] = 0.0
             item['copies_before'] = getattr(serializer, 'copies', 0)
@@ -453,6 +574,10 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
                     item_stats['counts'] = counts
                 if gauges:
                     item_stats['gauges'] = gauges
+            if hasattr(worker, 'item_done'):
+                worker.item_done()
+            if health_on and hb_snapshot is not None:
+                item_stats['heartbeats'] = hb_snapshot()
             if trace_enabled:
                 item_spans.append(('process_item', 'worker', process_start,
                                    elapsed, trace_pid, threading.get_ident(),
@@ -462,7 +587,16 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
                 item_spans = []
                 item_stats['spans'] = spans
             send([b''], VentilatedItemProcessedMessage(stats=item_stats))
+            if health_on and publish_beat['fn'] is not None:
+                # the accounting send's back-pressure path resumes at
+                # 'processing'; between items the truthful stage is idle
+                publish_beat['fn']('idle')
     finally:
+        if publish_beat['fn'] is not None:
+            publish_beat['fn']('stopped')
+        hb_stop.set()
+        if hb_thread is not None:
+            hb_thread.join(timeout=5)
         worker.shutdown()
         send([b''], _WorkerTerminated(worker_id))
         for sock in (work_receiver, control_receiver, results_sender):

@@ -193,6 +193,14 @@ def effective_io_s(snapshot: dict) -> float:
             - snapshot.get('readahead_wait_s', 0.0))
 
 
+def progress_marker(snapshot: dict) -> tuple:
+    """``(items_out, bytes_moved)`` of a snapshot — the monotone pair the
+    :class:`~petastorm_tpu.health.PipelineWatchdog` compares across ticks to
+    report whether the pipeline made any global progress between
+    evaluations (``items_out_delta`` in its verdict)."""
+    return (snapshot.get('items_out', 0), snapshot.get('bytes_moved', 0))
+
+
 def readahead_hit_rate(snapshot: dict) -> float:
     """Fraction of row-group reads served from the prefetch queue."""
     hits = snapshot.get('readahead_hits', 0)

@@ -62,6 +62,8 @@ class WorkerThread(threading.Thread):
         # readers keep ventilated-piece order.
         pending = deque()
         hint = getattr(self._worker, 'prefetch_hint', None)
+        beat = getattr(self._worker, 'beat', None)
+        item_done = getattr(self._worker, 'item_done', None)
         try:
             while True:
                 if not pending:
@@ -91,6 +93,8 @@ class WorkerThread(threading.Thread):
                     # usually the front of that prefix
                     hint(list(pending))
                 args, kwargs = pending.popleft()
+                if beat is not None:
+                    beat('processing')
                 wait_before = self._publish_wait['s']
                 start = time.perf_counter()
                 try:
@@ -116,8 +120,12 @@ class WorkerThread(threading.Thread):
                     tracer.add_span('process_item', 'worker', start, elapsed)
                     if hasattr(self._worker, 'drain_spans'):
                         tracer.merge(self._worker.drain_spans())
+                if item_done is not None:
+                    item_done()
                 self._pool._put_result(VentilatedItemProcessedMessage())
         finally:
+            if beat is not None:
+                beat('stopped')
             if self._profiler:
                 self._profiler.disable()
                 self._pool._collect_profile(self._profiler)
@@ -144,6 +152,7 @@ class ThreadPool:
         self._profiles_lock = threading.Lock()
         self._stop_event = threading.Event()
         self._threads = []
+        self._workers = []
         self._ventilator = None
         self._accounting_lock = threading.Lock()
         self._ventilated_items = 0
@@ -159,15 +168,19 @@ class ThreadPool:
         for worker_id in range(self._workers_count):
             # Per-worker publish wrapper: time spent blocked on a full results
             # queue is back-pressure, not decode; the worker thread subtracts
-            # it from its process() wall time.
+            # it from its process() wall time. The worker is constructed with
+            # the wrapper, so its beat fn arrives via the holder afterwards.
             publish_wait = {'s': 0.0}
+            holder = {}
 
-            def publish(item, _wait=publish_wait):
+            def publish(item, _wait=publish_wait, _holder=holder):
                 start = time.perf_counter()
-                self._put_result(item)
+                self._put_result(item, beat=_holder.get('beat'))
                 _wait['s'] += time.perf_counter() - start
 
             worker = worker_class(worker_id, publish, worker_args)
+            holder['beat'] = getattr(worker, 'beat', None)
+            self._workers.append(worker)
             thread = WorkerThread(self, worker, self._profiling_enabled,
                                   publish_wait)
             self._threads.append(thread)
@@ -180,14 +193,25 @@ class ThreadPool:
             self._ventilated_items += 1
         self._work_queue.put((args, kwargs))
 
-    def _put_result(self, item):
+    def _put_result(self, item, beat=None):
         """Bounded put that gives up when the pool is stopping
-        (reference ``_stop_aware_put``, ``thread_pool.py:200-214``)."""
+        (reference ``_stop_aware_put``, ``thread_pool.py:200-214``).
+
+        ``beat`` (the publishing worker's heartbeat fn) marks time blocked
+        on a full queue as idle-class ``backpressured``: a paused consumer
+        (checkpoint save, eval) must not read as a stalled worker — the
+        same exemption the ventilator's ``_acquire_slot`` applies."""
+        blocked = False
         while not self._stop_event.is_set():
             try:
                 self._results_queue.put(item, timeout=0.05)
+                if blocked and beat is not None:
+                    beat('processing')
                 return
             except queue.Full:
+                if not blocked and beat is not None:
+                    blocked = True
+                    beat('backpressured')
                 continue
 
     def _all_work_consumed(self) -> bool:
@@ -266,6 +290,16 @@ class ThreadPool:
     def _collect_profile(self, profiler):
         with self._profiles_lock:
             self._profiles.append(profiler)
+
+    def heartbeats(self):
+        """Live per-entity heartbeat records (workers run in-process, so
+        their ``WorkerBase`` records are read directly — never stale)."""
+        records = {}
+        for worker in self._workers:
+            snapshot = getattr(worker, 'heartbeat_snapshot', None)
+            if snapshot is not None:
+                records.update(snapshot())
+        return records
 
     @property
     def diagnostics(self):

@@ -51,10 +51,17 @@ class BackPressuredVentilator(Ventilator):
     ``_acquire_slot`` returns False on stop)."""
 
     def __init__(self, ventilate_fn, max_in_flight: int,
-                 interval_s: float = 0.01):
+                 interval_s: float = 0.01, heartbeat=None):
         super().__init__(ventilate_fn)
         self._max_in_flight = max_in_flight
         self._interval = interval_s
+        #: Optional ``heartbeat(entity, stage)`` callable (the reader's
+        #: ``HealthMonitor.beat``). Stage ``ventilate`` is active work;
+        #: ``backpressured`` (blocked on the in-flight bound) and ``done``
+        #: are idle-class stages — see ``health.IDLE_STAGES`` (a stalled
+        #: consumer must indict the wedged entity, not the ventilator that
+        #: is correctly waiting on it).
+        self._heartbeat = heartbeat
         self._in_flight = 0
         # Condition, not a sleep-poll: a fixed poll period caps ventilation at
         # ~1/interval items/sec, which throttles the whole pipeline once row
@@ -74,19 +81,31 @@ class BackPressuredVentilator(Ventilator):
         self._thread.start()
 
     def _run(self):
+        self._beat('ventilate')
         self._ventilate_loop()
         self._completed.set()
+        self._beat('done')
+
+    def _beat(self, stage):
+        if self._heartbeat is not None:
+            self._heartbeat('ventilator', stage)
 
     def _ventilate_loop(self):
         raise NotImplementedError
 
     def _acquire_slot(self) -> bool:
         """Block until an in-flight slot frees up; False if stopped."""
+        first_wait = True
         with self._slot_cv:
             while not self._stop_event.is_set():
                 if self._in_flight < self._max_in_flight:
                     self._in_flight += 1
+                    self._beat('ventilate')
                     return True
+                if first_wait:
+                    # beat once per back-pressure episode, not per poll tick
+                    first_wait = False
+                    self._beat('backpressured')
                 self._slot_cv.wait(timeout=self._interval)
         return False
 
@@ -126,6 +145,9 @@ class ConcurrentVentilator(BackPressuredVentilator):
     :param max_ventilation_queue_size: bound on in-flight (ventilated but not yet
         processed) items; back-pressure (reference ``ventilator.py:146-149``).
     :param ventilation_interval_s: poll period while back-pressured.
+    :param heartbeat: optional ``heartbeat(entity, stage)`` callable; the
+        ventilator thread publishes liveness as entity ``'ventilator'``
+        (see :mod:`petastorm_tpu.health`).
     """
 
     def __init__(self, ventilate_fn, items: List, iterations: Optional[int] = 1,
@@ -133,13 +155,15 @@ class ConcurrentVentilator(BackPressuredVentilator):
                  random_seed: Optional[int] = None,
                  max_ventilation_queue_size: Optional[int] = None,
                  ventilation_interval_s: float = 0.01,
-                 start_epoch: int = 0):
+                 start_epoch: int = 0,
+                 heartbeat=None):
         if iterations is not None and iterations < 1:
             raise ValueError('iterations must be positive or None, got {}'.format(iterations))
         items = list(items)
         super().__init__(ventilate_fn,
                          max_in_flight=max_ventilation_queue_size or len(items) or 1,
-                         interval_s=ventilation_interval_s)
+                         interval_s=ventilation_interval_s,
+                         heartbeat=heartbeat)
         self._items = items
         self._iterations_remaining = iterations
         self._randomize_item_order = randomize_item_order

@@ -2,6 +2,7 @@
 
 import os
 import threading
+import time
 from abc import ABC, abstractmethod
 
 
@@ -29,6 +30,21 @@ class WorkerBase(ABC):
         self.trace_spans = []
         self.tracing_enabled = isinstance(args, dict) and bool(args.get('trace'))
         self._trace_pid = os.getpid()
+        #: Per-entity heartbeat records: ``entity -> (stage, ts, items)``
+        #: where ``ts`` is ``time.perf_counter()``. The worker's own entity
+        #: (``worker-<id>``) beats via :meth:`beat`; auxiliary threads it
+        #: owns (the readahead reader) beat their own entity via
+        #: :meth:`beat_entity`. Thread/dummy pools read this dict live;
+        #: process workers ship :meth:`heartbeat_snapshot` back in the
+        #: accounting message and a low-frequency heartbeat frame. Each beat
+        #: replaces a whole tuple, so cross-thread reads are safe.
+        self.heartbeats = {}
+        self.health_enabled = not (isinstance(args, dict)
+                                   and args.get('health') is False)
+        self._entity = 'worker-{}'.format(worker_id)
+        self._items_done = 0
+        if self.health_enabled:
+            self.beat('starting')
 
     @abstractmethod
     def process(self, *args, **kwargs):
@@ -37,8 +53,40 @@ class WorkerBase(ABC):
 
     def record_time(self, stage: str, seconds: float) -> None:
         """Accumulate ``seconds`` of wall time against a pipeline stage
-        (see :mod:`petastorm_tpu.workers.stats` for the stage names)."""
+        (see :mod:`petastorm_tpu.workers.stats` for the stage names). Also
+        counts as a heartbeat: finishing a timed stage is progress."""
         self.stage_times[stage] = self.stage_times.get(stage, 0.0) + seconds
+        if self.health_enabled:
+            self.beat(stage[:-2] if stage.endswith('_s') else stage)
+
+    # -- heartbeats ------------------------------------------------------------
+
+    def beat(self, stage: str) -> None:
+        """Publish a heartbeat for this worker's own entity: it is now in
+        ``stage`` (e.g. ``io``/``decode``/``idle``) and still making
+        progress. A few assignments — cheap enough for per-stage calls."""
+        if self.health_enabled:
+            self.heartbeats[self._entity] = (stage, time.perf_counter(),
+                                             self._items_done)
+
+    def beat_entity(self, entity: str, stage: str, items: int = 0) -> None:
+        """Publish a heartbeat for an auxiliary entity this worker owns
+        (e.g. its background readahead reader thread)."""
+        if self.health_enabled:
+            self.heartbeats[entity] = (stage, time.perf_counter(), items)
+
+    def item_done(self) -> None:
+        """Mark one ventilated item fully processed (pools call this after
+        ``process()`` returns); bumps the items counter and beats ``idle``."""
+        self._items_done += 1
+        self.beat('idle')
+
+    def heartbeat_snapshot(self) -> dict:
+        """``{entity: {'stage', 'ts', 'items', 'pid'}}`` for every entity
+        this worker publishes. Safe to call from any thread."""
+        pid = self._trace_pid
+        return {entity: {'stage': stage, 'ts': ts, 'items': items, 'pid': pid}
+                for entity, (stage, ts, items) in list(self.heartbeats.items())}
 
     def record_count(self, name: str, n: int = 1) -> None:
         """Accumulate ``n`` against a ``ReaderStats`` counter."""

@@ -1,0 +1,567 @@
+"""Live pipeline health tests: heartbeat registry semantics, the shared
+classification, watchdog stall detection against deliberately wedged workers
+(thread + process pools), flight-recorder dump contents, and the HTTP debug
+endpoint (including /healthz flipping 200 -> 503 on a stall)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from petastorm_tpu.health import (DEGRADED, HEALTHY, STALLED, STARVING,
+                                  DebugServer, HealthMonitor,
+                                  HeartbeatRegistry, PipelineWatchdog,
+                                  build_flight_record, classify_pipeline,
+                                  heartbeats_enabled, resolve_debug_port,
+                                  thread_stacks, write_flight_record)
+from petastorm_tpu.test_util.pool_workers import WedgeWorker
+from petastorm_tpu.workers import EmptyResultError
+
+_now = time.perf_counter
+
+
+def _record(stage, age_s=0.0, items=0, pid=0):
+    return {'stage': stage, 'ts': _now() - age_s, 'items': items, 'pid': pid,
+            'age_s': age_s}
+
+
+def _wait_for(predicate, timeout=15.0, interval=0.02, what='condition'):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError('timed out waiting for {}'.format(what))
+
+
+def _http_get(port, route):
+    from http.client import HTTPConnection
+    conn = HTTPConnection('127.0.0.1', port, timeout=10)
+    try:
+        conn.request('GET', route)
+        response = conn.getresponse()
+        return response.status, response.read().decode('utf-8')
+    finally:
+        conn.close()
+
+
+class TestHeartbeatRegistry:
+    def test_beat_and_snapshot_ages(self):
+        registry = HeartbeatRegistry()
+        registry.beat('worker-0', 'decode', items=3)
+        snapshot = registry.snapshot()
+        record = snapshot['worker-0']
+        assert record['stage'] == 'decode'
+        assert record['items'] == 3
+        assert record['pid'] == os.getpid()
+        assert 0.0 <= record['age_s'] < 1.0
+        # a later beat without items keeps the items counter
+        registry.beat('worker-0', 'idle')
+        assert registry.snapshot()['worker-0']['items'] == 3
+
+    def test_update_preserves_foreign_records(self):
+        registry = HeartbeatRegistry()
+        ts = _now() - 2.5
+        registry.update({'worker-1': {'stage': 'io', 'ts': ts, 'items': 7,
+                                      'pid': 4242}})
+        record = registry.snapshot()['worker-1']
+        assert record['pid'] == 4242
+        assert record['age_s'] == pytest.approx(2.5, abs=0.5)
+
+    def test_monitor_merges_sources(self):
+        monitor = HealthMonitor()
+        monitor.beat('ventilator', 'ventilate')
+        monitor.add_source(lambda: {'worker-0': {'stage': 'decode',
+                                                 'ts': _now(), 'items': 1,
+                                                 'pid': 1}})
+        merged = monitor.heartbeats()
+        assert set(merged) == {'ventilator', 'worker-0'}
+        assert all('age_s' in r for r in merged.values())
+
+    def test_monitor_survives_dying_source(self):
+        monitor = HealthMonitor()
+        monitor.beat('ventilator', 'done')
+
+        def dead_source():
+            raise RuntimeError('pool is gone')
+
+        monitor.add_source(dead_source)
+        assert set(monitor.heartbeats()) == {'ventilator'}
+
+    def test_env_gates(self, monkeypatch):
+        monkeypatch.delenv('PETASTORM_TPU_HEALTH', raising=False)
+        assert heartbeats_enabled()
+        monkeypatch.setenv('PETASTORM_TPU_HEALTH', '0')
+        assert not heartbeats_enabled()
+        monkeypatch.delenv('PETASTORM_TPU_DEBUG_PORT', raising=False)
+        assert resolve_debug_port(None) is None
+        assert resolve_debug_port(8080) == 8080
+        monkeypatch.setenv('PETASTORM_TPU_DEBUG_PORT', '9999')
+        assert resolve_debug_port(None) == 9999
+        assert resolve_debug_port(0) == 0   # explicit kwarg beats the env
+        # a malformed or out-of-range job-wide env var disables the
+        # endpoint, never raises
+        monkeypatch.setenv('PETASTORM_TPU_DEBUG_PORT', 'auto')
+        assert resolve_debug_port(None) is None
+        monkeypatch.setenv('PETASTORM_TPU_DEBUG_PORT', '70000')
+        assert resolve_debug_port(None) is None
+        with pytest.raises(ValueError):
+            resolve_debug_port('auto')   # explicit kwarg garbage stays loud
+
+
+class TestClassifyPipeline:
+    def test_idle_is_healthy_forever(self):
+        heartbeats = {'worker-0': _record('idle', age_s=9999.0),
+                      'ventilator': _record('done', age_s=9999.0),
+                      'loader-prefetch': _record('backpressured', age_s=500.0)}
+        assert classify_pipeline(heartbeats, stall_after_s=1.0)['state'] == HEALTHY
+
+    def test_active_past_threshold_is_stalled(self):
+        heartbeats = {'worker-0': _record('decode', age_s=10.0),
+                      'worker-1': _record('idle', age_s=10.0)}
+        verdict = classify_pipeline(heartbeats, stall_after_s=1.0)
+        assert verdict['state'] == STALLED
+        [stalled] = verdict['stalled_entities']
+        assert stalled['entity'] == 'worker-0'
+        assert stalled['stage'] == 'decode'
+        assert 'worker-0' in verdict['hint']
+
+    def test_active_past_half_threshold_is_degraded(self):
+        heartbeats = {'worker-0': _record('io', age_s=0.7)}
+        verdict = classify_pipeline(heartbeats, stall_after_s=1.0)
+        assert verdict['state'] == DEGRADED
+        assert verdict['slow_entities'][0]['entity'] == 'worker-0'
+
+    def test_io_bound_empty_queue_is_starving(self):
+        heartbeats = {'worker-0': _record('io', age_s=0.01)}
+        snapshot = {'worker_io_s': 9.0, 'worker_decode_s': 1.0,
+                    'queue_depth': 0, 'items_out': 50}
+        verdict = classify_pipeline(heartbeats, snapshot, stall_after_s=60.0)
+        assert verdict['state'] == STARVING
+        assert verdict['bottleneck'] == 'io'
+        # with results queued up the same ratios are just io-bound, not
+        # a starving consumer
+        snapshot['queue_depth'] = 5
+        assert classify_pipeline(heartbeats, snapshot,
+                                 stall_after_s=60.0)['state'] == HEALTHY
+
+    def test_agrees_with_infeed_diagnosis(self):
+        """The satellite contract: the CLI's -d classification and the
+        watchdog's share one definition."""
+        from petastorm_tpu.jax_utils import infeed_diagnosis
+        heartbeats = {'worker-0': _record('decode', age_s=10.0)}
+        snapshot = {'worker_io_s': 1.0, 'worker_decode_s': 8.0}
+        diag = infeed_diagnosis(snapshot, heartbeats=heartbeats,
+                                stall_after_s=1.0)
+        verdict = classify_pipeline(heartbeats, snapshot, stall_after_s=1.0)
+        assert diag['pipeline_state'] == verdict['state'] == STALLED
+        assert diag['bottleneck'] == 'stalled'
+        assert diag['stalled_entities'] == verdict['stalled_entities']
+        # healthy pipeline: heartbeat-aware diagnosis degrades to the plain
+        # bottleneck reading
+        healthy = infeed_diagnosis(snapshot,
+                                   heartbeats={'worker-0': _record('idle')},
+                                   stall_after_s=1.0)
+        assert healthy['pipeline_state'] == HEALTHY
+        assert healthy['bottleneck'] == 'decode'
+
+
+class TestThreadStacksAndFlightRecord:
+    def test_thread_stacks_cover_live_threads(self):
+        stop = threading.Event()
+        thread = threading.Thread(target=stop.wait, name='stack-probe',
+                                  daemon=True)
+        thread.start()
+        try:
+            stacks = thread_stacks()
+            me = [s for name, s in stacks.items()
+                  if name.startswith('MainThread')]
+            assert me and 'test_thread_stacks_cover_live_threads' in me[0]
+            assert any(name.startswith('stack-probe') for name in stacks)
+        finally:
+            stop.set()
+
+    def test_flight_record_roundtrip(self, tmp_path):
+        from petastorm_tpu.tracing import Tracer
+        tracer = Tracer()
+        tracer.add_span('decode_columns', 'decode', 1.0, 0.5)
+        heartbeats = {'worker-0': _record('decode', age_s=5.0)}
+        verdict = classify_pipeline(heartbeats, stall_after_s=1.0)
+        record = build_flight_record(verdict, heartbeats,
+                                     snapshot={'items_out': 3},
+                                     queues={'queue_depth': 0},
+                                     tracer=tracer)
+        path = write_flight_record(str(tmp_path / 'flight.json'), record)
+        blob = json.load(open(path))
+        assert blob['kind'] == 'petastorm_tpu_flight_record'
+        assert blob['verdict']['state'] == STALLED
+        assert blob['heartbeats']['worker-0']['stage'] == 'decode'
+        assert blob['stats']['items_out'] == 3
+        assert blob['span_tail'][0]['name'] == 'decode_columns'
+        assert any('MainThread' in name for name in blob['stacks'])
+
+
+class _PoolConsumer:
+    """Drains pool.get_results on a background thread (a wedged pipeline
+    blocks the consumer — exactly the production shape the watchdog sees)."""
+
+    def __init__(self, pool):
+        self.results = []
+        self.error = None
+        self._pool = pool
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            while True:
+                self.results.append(self._pool.get_results())
+        except EmptyResultError:
+            pass
+        except Exception as e:  # pragma: no cover - surfaced by the test
+            self.error = e
+
+    def join(self, timeout=30):
+        self._thread.join(timeout=timeout)
+        assert not self._thread.is_alive(), 'consumer never finished'
+        assert self.error is None, self.error
+
+
+class TestWatchdogThreadPool:
+    def test_wedge_detected_dumped_and_recovered(self, tmp_path):
+        from petastorm_tpu.workers.thread_pool import ThreadPool
+        release = threading.Event()
+        pool = ThreadPool(2)
+        pool.start(WedgeWorker, {'wedge_on': 3, 'wedge_event': release,
+                                 'max_wait_s': 120})
+        stalls = []
+        watchdog = PipelineWatchdog(pool.heartbeats, pool.stats.snapshot,
+                                    stall_after_s=0.4, interval_s=0.05,
+                                    on_stall=stalls.append)
+        watchdog.start()
+        try:
+            for i in range(6):
+                pool.ventilate(i)
+            consumer = _PoolConsumer(pool)
+            _wait_for(lambda: stalls, what='watchdog stall callback')
+            verdict = stalls[0]
+            assert verdict['state'] == STALLED
+            [stalled] = verdict['stalled_entities']
+            assert stalled['stage'] == 'decode'
+            assert stalled['entity'].startswith('worker-')
+            assert stalled['age_s'] > 0.4
+
+            # flight record names the wedged entity and carries the evidence
+            record = build_flight_record(verdict, pool.heartbeats(),
+                                         pool.stats.snapshot())
+            path = write_flight_record(str(tmp_path / 'flight.json'), record)
+            blob = json.load(open(path))
+            assert blob['heartbeats'][stalled['entity']]['stage'] == 'decode'
+            assert any('WedgeWorker' in stack or 'wedge' in stack
+                       for stack in blob['stacks'].values()), \
+                'stack dump must show where the worker is wedged'
+
+            # release the wedge: the stream completes and the verdict recovers
+            release.set()
+            consumer.join()
+            assert sorted(consumer.results) == [0, 1, 2, 3, 4, 5]
+            _wait_for(lambda: watchdog.evaluate()['state'] == HEALTHY,
+                      what='recovery to healthy')
+            assert watchdog.last_verdict['items_out'] == 6
+        finally:
+            release.set()
+            watchdog.stop()
+            pool.stop()
+            pool.join()
+
+    def test_publish_blocked_worker_is_backpressured_not_stalled(self):
+        """A worker blocked on a FULL results queue (consumer paused for a
+        checkpoint/eval) must read as idle-class back-pressure, never as a
+        stalled pipeline."""
+        from petastorm_tpu.test_util.pool_workers import MultiEmitWorker
+        from petastorm_tpu.workers.thread_pool import ThreadPool
+        pool = ThreadPool(1, results_queue_size=1)
+        pool.start(MultiEmitWorker, {})
+        try:
+            # one item emitting 4 results: the first fills the queue, the
+            # second blocks MID-ITEM inside publish — the exact shape the
+            # review flagged (active stage + paused consumer = false stall)
+            pool.ventilate(7, 4)
+            _wait_for(lambda: pool.heartbeats().get(
+                'worker-0', {}).get('stage') == 'backpressured',
+                what='backpressured beat from a publish-blocked worker')
+            time.sleep(0.3)   # let the blocked state age past the threshold
+            verdict = classify_pipeline(pool.heartbeats(),
+                                        pool.stats.snapshot(),
+                                        stall_after_s=0.2)
+            assert verdict['state'] == HEALTHY, verdict
+            consumer = _PoolConsumer(pool)
+            consumer.join()
+            assert consumer.results == [7, 7, 7, 7]
+        finally:
+            pool.stop()
+            pool.join()
+
+    def test_process_pool_ages_clamp_to_last_drain(self):
+        """Shipped records must not age into false stalls while the CONSUMER
+        is the one not polling: reported age freezes at the observation
+        point and resumes once draining resumes."""
+        from petastorm_tpu.workers.process_pool import ProcessPool
+        pool = ProcessPool(1)
+        now = _now()
+        pool._merge_heartbeats({'worker-0': {'stage': 'decode',
+                                             'ts': now - 100.0,
+                                             'items': 3, 'pid': 1}})
+        # last observed 99.8s ago: the record was 0.2s old then
+        with pool._hb_lock:
+            pool._last_drain = now - 99.8
+        verdict = classify_pipeline(pool.heartbeats(), stall_after_s=1.0)
+        assert verdict['state'] == HEALTHY, verdict
+        # consumer polls again: the record is now genuinely stale
+        with pool._hb_lock:
+            pool._last_drain = _now()
+        verdict = classify_pipeline(pool.heartbeats(), stall_after_s=1.0)
+        assert verdict['state'] == STALLED
+
+    def test_on_stall_fires_once_per_episode(self):
+        """Edge-triggered: a persistent stall produces one dump, not one per
+        tick; recovery re-arms."""
+        records = {'worker-0': {'stage': 'decode', 'ts': _now() - 99.0,
+                                'items': 0, 'pid': 0}}
+        stalls = []
+        watchdog = PipelineWatchdog(lambda: dict(records),
+                                    stall_after_s=0.1, interval_s=0.02,
+                                    on_stall=stalls.append)
+        watchdog.start()
+        try:
+            _wait_for(lambda: stalls, what='first stall')
+            time.sleep(0.2)
+            assert len(stalls) == 1
+            records['worker-0'] = {'stage': 'idle', 'ts': _now(), 'items': 1,
+                                   'pid': 0}
+            _wait_for(lambda: watchdog.last_verdict['state'] == HEALTHY,
+                      what='recovery')
+            records['worker-0'] = {'stage': 'decode', 'ts': _now() - 99.0,
+                                   'items': 1, 'pid': 0}
+            _wait_for(lambda: len(stalls) == 2, what='re-armed stall')
+        finally:
+            watchdog.stop()
+        assert watchdog._thread is None
+
+
+class TestWatchdogProcessPool:
+    def test_wedged_process_worker_beats_over_zmq(self, tmp_path):
+        """The wedged worker never completes its item, so its 'decode' beat
+        can only reach the consumer through the low-frequency ZMQ heartbeat
+        frame — the piece of the design this test pins down."""
+        zmq = pytest.importorskip('zmq')  # noqa: F841
+        from petastorm_tpu.workers.process_pool import ProcessPool
+        release = str(tmp_path / 'release-the-wedge')
+        pool = ProcessPool(1)
+        pool.start(WedgeWorker, {'wedge_on': 2, 'release_file': release,
+                                 'max_wait_s': 120,
+                                 'heartbeat_interval_s': 0.1})
+        watchdog = PipelineWatchdog(pool.heartbeats, pool.stats.snapshot,
+                                    stall_after_s=0.6, interval_s=0.05)
+        try:
+            for i in range(4):
+                pool.ventilate(i)
+            consumer = _PoolConsumer(pool)
+            _wait_for(lambda: watchdog.evaluate()['state'] == STALLED,
+                      what='process-worker stall detection')
+            [stalled] = watchdog.last_verdict['stalled_entities']
+            assert stalled['entity'] == 'worker-0'
+            assert stalled['stage'] == 'decode'
+            heartbeats = pool.heartbeats()
+            assert heartbeats['worker-0']['pid'] != os.getpid()
+
+            with open(release, 'w') as f:
+                f.write('go')
+            consumer.join()
+            assert sorted(consumer.results) == [0, 1, 2, 3]
+            _wait_for(lambda: watchdog.evaluate()['state'] == HEALTHY,
+                      what='recovery after release')
+            assert pool.heartbeats()['worker-0']['items'] == 4
+        finally:
+            with open(release, 'w') as f:
+                f.write('go')
+            watchdog.stop()
+            pool.stop()
+            pool.join()
+
+
+class TestDebugServer:
+    def test_healthz_flips_200_to_503_and_back(self, tmp_path):
+        from petastorm_tpu.workers.thread_pool import ThreadPool
+        release = threading.Event()
+        pool = ThreadPool(2)
+        pool.start(WedgeWorker, {'wedge_on': 1, 'wedge_event': release,
+                                 'max_wait_s': 120})
+        watchdog = PipelineWatchdog(pool.heartbeats, pool.stats.snapshot,
+                                    stall_after_s=0.4)
+        server = DebugServer(watchdog.evaluate, pool.stats.snapshot,
+                             pool.heartbeats, port=0).start()
+        try:
+            # before any stall: healthy -> 200
+            status, body = _http_get(server.port, '/healthz')
+            assert status == 200
+            assert json.loads(body)['state'] == HEALTHY
+
+            for i in range(4):
+                pool.ventilate(i)
+            consumer = _PoolConsumer(pool)
+
+            def stalled_503():
+                status, body = _http_get(server.port, '/healthz')
+                return status == 503 and json.loads(body)['state'] == STALLED
+            _wait_for(stalled_503, what='/healthz flipping to 503')
+
+            release.set()
+            consumer.join()
+
+            def healthy_again():
+                status, _ = _http_get(server.port, '/healthz')
+                return status == 200
+            _wait_for(healthy_again, what='/healthz recovering to 200')
+        finally:
+            release.set()
+            server.stop()
+            watchdog.stop()
+            pool.stop()
+            pool.join()
+
+    def test_metrics_diagnostics_stacks_routes(self):
+        from petastorm_tpu.workers.stats import ReaderStats
+        stats = ReaderStats()
+        stats.add('items_out', 7)
+        registry = HeartbeatRegistry()
+        registry.beat('worker-0', 'idle', items=7)
+        watchdog = PipelineWatchdog(registry.snapshot, stats.snapshot,
+                                    stall_after_s=5.0)
+        server = DebugServer(watchdog.evaluate, stats.snapshot,
+                             registry.snapshot, port=0).start()
+        try:
+            status, body = _http_get(server.port, '/metrics')
+            assert status == 200
+            assert 'petastorm_tpu_items_out 7.0' in body
+            assert '# TYPE petastorm_tpu_items_out gauge' in body
+
+            status, body = _http_get(server.port, '/diagnostics')
+            assert status == 200
+            blob = json.loads(body)
+            assert blob['stats']['items_out'] == 7
+            assert blob['heartbeats']['worker-0']['stage'] == 'idle'
+            assert blob['verdict']['state'] == HEALTHY
+
+            status, body = _http_get(server.port, '/stacks')
+            assert status == 200
+            assert 'MainThread' in body
+
+            status, _ = _http_get(server.port, '/nope')
+            assert status == 404
+        finally:
+            server.stop()
+        # stop is idempotent and leaves no server thread behind
+        server.stop()
+        assert server._thread is None
+
+
+class TestWatchdogProgressWindow:
+    def test_on_demand_evaluate_does_not_reset_delta_baseline(self):
+        """/healthz probes must not shrink the progress window the watchdog
+        thread's stall verdict reports."""
+        stats = {'items_out': 10}
+        watchdog = PipelineWatchdog(lambda: {}, lambda: dict(stats),
+                                    stall_after_s=60.0)
+        assert watchdog.evaluate(_advance_progress_window=True)[
+            'items_out_delta'] == 10
+        stats['items_out'] = 25
+        # two probes in a row: both see the full delta since the last tick
+        assert watchdog.evaluate()['items_out_delta'] == 15
+        assert watchdog.evaluate()['items_out_delta'] == 15
+        # the thread's own tick advances the window
+        assert watchdog.evaluate(_advance_progress_window=True)[
+            'items_out_delta'] == 15
+        assert watchdog.evaluate()['items_out_delta'] == 0
+
+
+class TestReaderHealthIntegration:
+    def test_reader_heartbeats_and_endpoints(self, synthetic_dataset):
+        from petastorm_tpu.reader import make_reader
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         debug_port=0) as reader:
+            count = sum(1 for _ in reader)
+            assert count == len(synthetic_dataset.data)
+            heartbeats = reader.health.heartbeats()
+            assert 'ventilator' in heartbeats
+            assert any(e.startswith('worker-') for e in heartbeats)
+            # all work done: every worker idle, every item accounted
+            assert sum(r['items'] for e, r in heartbeats.items()
+                       if e.startswith('worker-')) > 0
+            status, body = _http_get(reader.debug_port, '/healthz')
+            assert status == 200
+            assert json.loads(body)['state'] in (HEALTHY, STARVING)
+            status, body = _http_get(reader.debug_port, '/diagnostics')
+            assert json.loads(body)['stats']['items_out'] == count
+        # the context exit stopped the server: the port must be closed
+        with pytest.raises(OSError):
+            _http_get(reader.debug_port, '/healthz')
+
+    def test_reader_flight_record_dump(self, synthetic_dataset, tmp_path):
+        from petastorm_tpu.reader import make_reader
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         num_epochs=1, stall_timeout=60,
+                         flight_record_dir=str(tmp_path)) as reader:
+            sum(1 for _ in reader)
+            path = reader.dump_flight_record()
+            assert path.startswith(str(tmp_path))
+            blob = json.load(open(path))
+            assert blob['verdict']['state'] in (HEALTHY, STARVING)
+            assert 'worker-0' in blob['heartbeats']
+            assert blob['stats']['items_out'] > 0
+            assert blob['queues'].keys() >= {'queue_depth',
+                                             'shuffle_buffer_depth'}
+
+    def test_taken_debug_port_degrades_instead_of_crashing(
+            self, synthetic_dataset):
+        """With PETASTORM_TPU_DEBUG_PORT set job-wide, the SECOND reader in
+        the job finds the port taken — it must come up without an endpoint,
+        not die at construction."""
+        from petastorm_tpu.reader import make_reader
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         num_epochs=1, debug_port=0) as first:
+            with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                             num_epochs=1,
+                             debug_port=first.debug_port) as second:
+                assert second.debug_port is None
+                assert second.watchdog is not None   # watchdog stays armed
+                sum(1 for _ in second)
+            # the first reader's endpoint kept working throughout
+            status, _ = _http_get(first.debug_port, '/healthz')
+            assert status == 200
+            sum(1 for _ in first)
+
+    def test_health_env_kill_switch(self, synthetic_dataset, monkeypatch):
+        monkeypatch.setenv('PETASTORM_TPU_HEALTH', '0')
+        from petastorm_tpu.reader import make_reader
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=1, num_epochs=1) as reader:
+            sum(1 for _ in reader)
+            assert reader.health.heartbeats() == {}
+
+    def test_prefetch_thread_heartbeats(self, synthetic_dataset):
+        from petastorm_tpu.jax_utils import JaxDataLoader, prefetch_batches
+        from petastorm_tpu.reader import make_reader
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=1, num_epochs=1,
+                         schema_fields=['^id$']) as reader:
+            loader = JaxDataLoader(reader, batch_size=16)
+            assert loader.health is reader.health
+            batches = list(prefetch_batches(loader, size=2,
+                                            health=loader.health))
+            assert batches
+            record = reader.health.heartbeats()['loader-prefetch']
+            assert record['stage'] == 'done'

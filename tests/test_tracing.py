@@ -299,6 +299,67 @@ class TestMetricsEmitter:
         assert len(lines) == emitter.emit_count
 
 
+def _petastorm_threads():
+    import threading
+    return sorted(t.name for t in threading.enumerate()
+                  if t.is_alive() and t.name.startswith('petastorm-tpu-'))
+
+
+class TestReaderShutdownLifecycle:
+    """The daemon-thread shutdown contract shared by the metrics emitter,
+    the readahead reader threads, the health watchdog and the debug HTTP
+    server: Reader.stop()/join() is idempotent, joins everything with a
+    timeout, and leaves no dangling petastorm threads behind."""
+
+    def test_stop_join_idempotent_with_all_background_layers(
+            self, synthetic_dataset, tmp_path):
+        out = tmp_path / 'metrics.jsonl'
+        reader = make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                             workers_count=2, num_epochs=1,
+                             metrics_interval=0.05, metrics_out=str(out),
+                             io_readahead=2, debug_port=0, stall_timeout=30)
+        count = sum(1 for _ in reader)
+        assert count == len(synthetic_dataset.data)
+        reader.stop()
+        reader.join()
+        # a second (and third) stop/join must be clean no-ops — teardown
+        # paths cannot always know whether an earlier join already ran
+        reader.stop()
+        reader.join()
+        reader.join()
+        assert reader._metrics_emitter._thread is None
+        assert reader._watchdog._thread is None
+        assert reader._debug_server._thread is None
+        assert _petastorm_threads() == [], \
+            'dangling petastorm threads after Reader.join()'
+
+    def test_shutdown_clean_after_pool_died_uncleanly(self, synthetic_dataset,
+                                                      tmp_path):
+        """The health/metrics layers must come down even when the pool below
+        is a corpse (killed worker interpreters mid-stream)."""
+        out = tmp_path / 'metrics.jsonl'
+        reader = make_reader(synthetic_dataset.url, reader_pool_type='process',
+                             workers_count=2, num_epochs=None,
+                             metrics_interval=0.05, metrics_out=str(out),
+                             debug_port=0, stall_timeout=30)
+        it = iter(reader)
+        for _ in range(5):
+            next(it)
+        for proc in reader._pool._processes:
+            proc.kill()
+        with pytest.raises((RuntimeError, StopIteration)):
+            for _ in range(100_000):
+                next(it)
+        reader.stop()
+        reader.join()
+        reader.join()   # idempotent even on this path
+        assert reader._metrics_emitter._thread is None
+        assert reader._watchdog._thread is None
+        assert reader._debug_server._thread is None
+        assert _petastorm_threads() == [], \
+            'dangling petastorm threads after unclean pool death'
+
+
 class TestTraceOverheadQuickBench:
     @pytest.mark.timeout(300)
     def test_quick_benchmark_smoke(self):
