@@ -127,6 +127,12 @@ def main():
     from petastorm_tpu.benchmark.trace_overhead import run_trace_overhead_bench
     trace_overhead = run_trace_overhead_bench(quick=True)
 
+    # -- lineage: default-on provenance/audit overhead (items/s on vs off) --
+    # Same smoke-vs-headline split: the <5% figure lives in BENCH_r10.json.
+    from petastorm_tpu.benchmark.lineage_overhead import \
+        run_lineage_overhead_bench
+    lineage_overhead = run_lineage_overhead_bench(quick=True)
+
     # -- north-star: train-step infeed overlap ------------------------------
     # Accelerator-scale configs for any non-CPU backend; dataset paths carry
     # the size parameters so a platform change can't reuse a stale store.
@@ -303,6 +309,7 @@ def main():
         'transport': transport,
         'readahead': readahead,
         'trace_overhead': trace_overhead,
+        'lineage_overhead': lineage_overhead,
         'northstar': {
             'platform': platform,
             'mnist_train': mnist.as_dict(),

@@ -43,6 +43,12 @@ python -m pytest tests/test_health.py -q
 echo '== health-overhead quick bench (heartbeats+watchdog+endpoint on vs off) =='
 python -m petastorm_tpu.benchmark.health_overhead --quick
 
+echo '== lineage quick checks (provenance, coverage audit, quarantine, replay) =='
+python -m pytest tests/test_lineage.py -q
+
+echo '== lineage-overhead quick bench (provenance+audit ledgers on vs off) =='
+python -m petastorm_tpu.benchmark.lineage_overhead --quick
+
 echo '== bench-docs consistency gate =='
 python ci/check_bench_docs.py
 

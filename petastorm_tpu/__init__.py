@@ -15,6 +15,7 @@ __all__ = ['make_reader', 'make_batch_reader', 'make_columnar_reader',
            'WeightedIndexedMixture',
            'TransformSpec', 'NoDataAvailableError',
            'make_jax_loader', 'make_dataset_converter', 'materialize_dataset',
+           'CoverageAuditor', 'Provenance',
            '__version__']
 
 
@@ -41,4 +42,7 @@ def __getattr__(name):
     if name == 'materialize_dataset':
         from petastorm_tpu.etl.dataset_metadata import materialize_dataset
         return materialize_dataset
+    if name in ('CoverageAuditor', 'Provenance'):
+        from petastorm_tpu import lineage
+        return getattr(lineage, name)
     raise AttributeError('module {!r} has no attribute {!r}'.format(__name__, name))

@@ -33,6 +33,10 @@ class ThroughputResult:
     #: ``-d`` prints, computed with the live heartbeats folded in so it can
     #: never disagree with the watchdog / ``/healthz``.
     diagnosis: Optional[dict] = None
+    #: The lineage coverage audit (``reader.lineage.coverage_report()``)
+    #: taken after the run when requested via ``audit=True`` — what the
+    #: CLI's ``--audit`` prints. ``None`` when not requested.
+    audit: Optional[dict] = None
 
 
 def _consume(iterator, count: int, batched: bool) -> int:
@@ -65,7 +69,9 @@ def reader_throughput(dataset_url: str,
                       metrics_interval: float = 0,
                       metrics_out: Optional[str] = None,
                       debug_port=None,
-                      stall_timeout: float = 0) -> ThroughputResult:
+                      stall_timeout: float = 0,
+                      audit: bool = False,
+                      on_decode_error: str = 'raise') -> ThroughputResult:
     """Measure reader throughput on ``dataset_url``.
 
     ``read_method='python'`` iterates raw reader rows/batches;
@@ -87,7 +93,8 @@ def reader_throughput(dataset_url: str,
     kwargs = dict(reader_pool_type=pool_type, workers_count=workers_count,
                   num_epochs=None, io_readahead=io_readahead, trace=trace,
                   metrics_interval=metrics_interval, metrics_out=metrics_out,
-                  debug_port=debug_port, stall_timeout=stall_timeout)
+                  debug_port=debug_port, stall_timeout=stall_timeout,
+                  on_decode_error=on_decode_error)
     if field_regex is not None:
         kwargs['schema_fields'] = field_regex
 
@@ -130,10 +137,16 @@ def reader_throughput(dataset_url: str,
             if watchdog is not None else None)
         if trace_path is not None and reader.tracer is not None:
             reader.tracer.export_chrome_trace(trace_path)
+        audit_report = None
+        if audit:
+            lineage = getattr(reader, 'lineage', None)
+            audit_report = (lineage.coverage_report()
+                            if lineage is not None else {'enabled': False})
 
     return ThroughputResult(samples_per_sec=actual / elapsed,
                             warmup_cycles=warmup_cycles,
                             measure_cycles=actual,
                             rss_mb=rss, cpu_percent=cpu,
                             diagnostics=diagnostics,
-                            diagnosis=diagnosis)
+                            diagnosis=diagnosis,
+                            audit=audit_report)

@@ -322,9 +322,13 @@ def thread_stacks() -> Dict[str, str]:
 def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
                         snapshot: Optional[dict] = None,
                         queues: Optional[dict] = None,
-                        tracer=None, span_tail: int = 500) -> dict:
+                        tracer=None, span_tail: int = 500,
+                        lineage: Optional[dict] = None) -> dict:
     """Assemble the flight-recorder artifact: everything needed to diagnose
-    a stall *after* the process is gone. JSON-able by construction."""
+    a stall *after* the process is gone. JSON-able by construction.
+    ``lineage`` (a tracker's ``flight_summary()``) adds the coverage audit
+    and recent quarantine records, so a stall dump also answers "what data
+    had the model seen, and what was dropped" (see ``docs/lineage.md``)."""
     record = {
         'kind': 'petastorm_tpu_flight_record',
         'written_at': time.time(),
@@ -338,16 +342,18 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
     if tracer is not None:
         record['span_tail'] = tracer.tail(span_tail)
         record['spans_dropped'] = tracer.dropped
+    if lineage is not None:
+        record['lineage'] = lineage
     return record
 
 
 def write_flight_record(path: str, record: dict) -> str:
-    """Write one flight record as JSON; returns ``path``."""
-    tmp = '{}.tmp.{}'.format(path, os.getpid())
-    with open(tmp, 'w') as f:
-        json.dump(record, f, indent=2, sort_keys=True, default=str)
-    os.replace(tmp, path)
-    return path
+    """Write one flight record as JSON; returns ``path``. Atomic (tmp file +
+    ``os.replace``, shared :func:`petastorm_tpu.utils.atomic_write`): a crash
+    mid-dump cannot leave truncated JSON that tooling rejects."""
+    from petastorm_tpu.utils import atomic_write
+    return atomic_write(path, lambda f: json.dump(
+        record, f, indent=2, sort_keys=True, default=str))
 
 
 class PipelineWatchdog:
@@ -464,7 +470,12 @@ class DebugServer:
       probe at it).
     - ``GET /metrics`` — the stats snapshot in Prometheus text-exposition
       format (the metrics emitter's formatter).
-    - ``GET /diagnostics`` — ``{stats, heartbeats, verdict}`` as JSON.
+    - ``GET /diagnostics`` — ``{stats, heartbeats, verdict}`` (plus the
+      lineage coverage audit when wired) as JSON.
+    - ``GET /coverage`` — the sample-lineage coverage audit
+      (:meth:`petastorm_tpu.lineage.LineageTracker.coverage_report`):
+      per-epoch exactly-once verdicts, dup/drop row groups, shuffle quality,
+      quarantine totals. 404 when the reader runs with lineage disabled.
     - ``GET /stacks`` — plain-text stack dump of every in-process thread.
 
     Requests are served on daemon threads (``ThreadingHTTPServer``);
@@ -475,10 +486,12 @@ class DebugServer:
     def __init__(self, evaluate_fn: Callable[[], dict],
                  snapshot_fn: Optional[Callable[[], dict]] = None,
                  heartbeats_fn: Optional[Callable[[], Dict[str, dict]]] = None,
-                 port: int = 0, prefix: str = 'petastorm_tpu'):
+                 port: int = 0, prefix: str = 'petastorm_tpu',
+                 coverage_fn: Optional[Callable[[], dict]] = None):
         self._evaluate_fn = evaluate_fn
         self._snapshot_fn = snapshot_fn or (lambda: {})
         self._heartbeats_fn = heartbeats_fn or (lambda: {})
+        self._coverage_fn = coverage_fn
         self._requested_port = port
         self._prefix = prefix
         self._server = None
@@ -521,8 +534,19 @@ class DebugServer:
                         blob = {'verdict': outer._evaluate_fn(),
                                 'stats': outer._snapshot_fn(),
                                 'heartbeats': outer._heartbeats_fn()}
+                        if outer._coverage_fn is not None:
+                            blob['coverage'] = outer._coverage_fn()
                         self._reply(200, 'application/json',
                                     json.dumps(blob, default=str))
+                    elif route == '/coverage':
+                        if outer._coverage_fn is None:
+                            self._reply(404, 'text/plain',
+                                        'lineage is disabled for this '
+                                        'reader (PETASTORM_TPU_LINEAGE=0)\n')
+                        else:
+                            self._reply(200, 'application/json',
+                                        json.dumps(outer._coverage_fn(),
+                                                   default=str))
                     elif route == '/stacks':
                         stacks = thread_stacks()
                         body = '\n'.join('== {} ==\n{}'.format(name, stack)
@@ -532,7 +556,8 @@ class DebugServer:
                     else:
                         self._reply(404, 'text/plain',
                                     'unknown route {}; try /healthz /metrics '
-                                    '/diagnostics /stacks\n'.format(route))
+                                    '/diagnostics /coverage /stacks\n'
+                                    .format(route))
                 except Exception as e:  # report, never kill the serve loop
                     logger.exception('debug endpoint request failed')
                     try:

@@ -29,6 +29,8 @@ from decimal import Decimal
 
 import numpy as np
 
+from petastorm_tpu.lineage import (LINEAGE_COLUMN, PACK_SHIFT, PROVENANCE_KEY,
+                                   BatchProvenance, pack_rows)
 from petastorm_tpu.readers.shuffling_buffer import (
     BatchedNoopShufflingBuffer, BatchedRandomShufflingBuffer,
     NoopShufflingBuffer, RandomShufflingBuffer)
@@ -341,6 +343,16 @@ class JaxDataLoader(JaxLoaderBase):
                                       'JaxDataLoader')
         self._cache = [] if inmemory_cache_all else None
         self._cache_complete = False
+        #: The reader's :class:`~petastorm_tpu.lineage.LineageTracker`. When
+        #: lineage is on, every batch rides a packed int64 source column
+        #: through the shuffling buffer and finished batches expose
+        #: ``batch['_provenance']`` (a
+        #: :class:`~petastorm_tpu.lineage.BatchProvenance`). NGram batches
+        #: carry no per-row column (windows span source rows); use
+        #: ``reader.explain_batch()`` at item granularity there.
+        self._lineage = getattr(reader, 'lineage', None)
+        self._lineage_on = (self._ngram is None
+                            and getattr(self._lineage, 'enabled', False))
         #: The reader pool's ReaderStats (None for readers without one):
         #: the loader gauges shuffle-buffer occupancy into it, and the
         #: device-staging helpers time ``jax.device_put`` against it.
@@ -377,10 +389,17 @@ class JaxDataLoader(JaxLoaderBase):
         else:
             gen = self._iter_rows()
         for batch in gen:
+            # the packed source column must never reach user transforms or
+            # the model: pop it here, re-attach as the provenance object
+            sources = (batch.pop(LINEAGE_COLUMN, None)
+                       if self._lineage_on and isinstance(batch, dict)
+                       else None)
             if self.pad_spec:
                 batch = pad_ragged_batch(batch, self.pad_spec)
             if self.transform_fn is not None:
                 batch = self.transform_fn(batch)
+            if sources is not None and isinstance(batch, dict):
+                batch[PROVENANCE_KEY] = BatchProvenance(sources, self._lineage)
             if self._cache is not None:
                 self._cache.append(batch)
             yield batch
@@ -410,16 +429,39 @@ class JaxDataLoader(JaxLoaderBase):
                 yield post(batch)
 
     def _iter_batched(self):
+        lineage_on = self._lineage_on
+        reader = self.reader
+
         def columns():
-            for chunk in self.reader:
-                yield sanitize_jax_types(
+            for chunk in reader:
+                cols = sanitize_jax_types(
                     chunk._asdict() if hasattr(chunk, '_asdict') else dict(chunk))
+                if lineage_on:
+                    seq = reader.last_seq
+                    n = len(next(iter(cols.values()))) if cols else 0
+                    if seq is not None and n:
+                        # one vectorized int64 column per chunk: the rows'
+                        # packed source ids survive shuffling/batching
+                        cols[LINEAGE_COLUMN] = pack_rows(seq, n)
+                yield cols
         return self._drive_batched_buffer(columns())
 
     def _iter_rows(self):
+        # per-ROW hook: read the results reader's plain attributes directly
+        # and pack inline — property indirection per row is measurable on
+        # small-row-group stores
+        results_reader = getattr(self.reader, '_results_reader', None)
+        lineage_on = self._lineage_on and results_reader is not None
+
         def prepare(row):
-            return sanitize_jax_types(row._asdict()
-                                      if hasattr(row, '_asdict') else dict(row))
+            d = sanitize_jax_types(row._asdict()
+                                   if hasattr(row, '_asdict') else dict(row))
+            if lineage_on:
+                seq = results_reader.last_seq
+                offset = results_reader.last_row_offset
+                if seq is not None and offset is not None:
+                    d[LINEAGE_COLUMN] = (seq << PACK_SHIFT) | offset
+            return d
         return self._iter_row_stream(prepare, self._collate)
 
     def _iter_ngram_chunked(self):
@@ -514,6 +556,11 @@ class JaxDataLoader(JaxLoaderBase):
         keys = rows[0].keys()
         out = {}
         for k in keys:
+            if k == LINEAGE_COLUMN:
+                # packed int sources: one fromiter, no per-row asarray
+                out[k] = np.fromiter((r[k] for r in rows), dtype=np.int64,
+                                     count=len(rows))
+                continue
             vals = [np.asarray(r[k]) for r in rows]
             shapes = {v.shape for v in vals}
             kinds = {v.dtype.kind for v in vals}
@@ -644,7 +691,12 @@ def stage_to_global(batch, named_sharding, stats=None, tracer=None):
     start = time.perf_counter() if timed else 0.0
     device, host = {}, {}
     for name, value in batch.items():
-        if _is_device_compatible(value):
+        if name == PROVENANCE_KEY:
+            # under '_host' with the other non-HBM values: every top-level
+            # entry except '_host' stays a jax.Array, so a staged batch can
+            # still be passed whole into jit
+            host[name] = value
+        elif _is_device_compatible(value):
             device[name] = jax.make_array_from_process_local_data(
                 named_sharding, value)
         else:
@@ -700,6 +752,7 @@ def infeed_diagnosis(snapshot: dict, heartbeats=None,
         'io_overlap_fraction': snapshot.get('io_overlap_fraction', 0.0),
         'readahead_hit_rate': readahead_hit_rate(snapshot),
         'recommended_io_readahead': recommend_io_readahead(snapshot),
+        'rows_quarantined': snapshot.get('rows_quarantined', 0),
         'hint': signals['hint'],
     }
     if heartbeats is not None:

@@ -1,0 +1,836 @@
+"""Sample-level data lineage: batch provenance, epoch coverage auditing, and
+bad-sample quarantine.
+
+The performance layers (``ReaderStats``, spans, heartbeats — PRs 1–4) observe
+*how fast* the pipeline moves; nothing observes *what data the model actually
+saw*. A silent duplicate or drop — a dying worker, a skewed shard, an
+off-by-one in shuffling — corrupts training invisibly, and a single corrupt
+sample kills the reader with no record of which row did it. Because the
+reader is row-group addressable end to end (every ventilated work item is one
+``(file, row_group)`` piece), exact lineage is cheap to carry: one compact
+record per *item*, never per row.
+
+Four pieces:
+
+- **Provenance records.** Every published item carries a
+  :class:`Provenance` (dataset digest, file index + path, row-group ordinal,
+  row-offset selection, epoch, shard, worker) attached at the worker and
+  shipped in-band: thread/dummy pools wrap the payload in a
+  :class:`LineageEnvelope`; the process pool rides the record in the
+  ``DATA`` control frame (the accounting-message pattern — payload bytes
+  stay zero-copy). The consumer-side :class:`LineageTracker` registers each
+  record into a bounded ring and keeps per-epoch delivery ledgers.
+- **Coverage auditing.** :class:`CoverageAuditor` asserts exactly-once row
+  delivery per epoch per shard from the ventilated-vs-delivered ledgers:
+  duplicates and drops are reported with their source row groups (the
+  post-mortem a killed worker needs), row-exact coverage is checked against
+  the row-group footers when every selection is transparent, and
+  shuffle-quality (item shuffle-lag distribution; per-batch
+  adjacent-source-run-length via :class:`BatchProvenance`) and inter-shard
+  skew metrics quantify *how well* shuffled/balanced the delivery was.
+- **Replay.** :func:`replay` re-fetches the exact rows of a recorded
+  provenance through the same predicate/row-group machinery the original
+  read used — bit-exact repro of a bad batch from its provenance alone.
+- **Quarantine.** ``on_decode_error='raise'|'skip'|'quarantine'`` turns
+  decode/transform exceptions into counted, provenance-tagged quarantine
+  records (``rows_quarantined``/``items_quarantined`` in ``ReaderStats``,
+  records on ``/coverage``, ``/diagnostics`` and in flight records) instead
+  of a dead worker; the quarantined rows are dropped and the epoch
+  completes.
+
+Lineage is **on by default** and designed to measure within noise: one
+namedtuple per row-group item on the worker side, one ring insert per item on
+the consumer side, and per-row work only as one vectorized ``int64`` column
+through the shuffling buffer (no per-row Python objects anywhere). Set
+``PETASTORM_TPU_LINEAGE=0`` to compile every publication site out. See
+``docs/lineage.md``.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: Environment variable gating lineage publication (default on).
+#: ``0``/``false``/``off`` disable envelopes, ledgers and batch columns.
+LINEAGE_ENV_VAR = 'PETASTORM_TPU_LINEAGE'
+
+#: Synthetic int64 column the JAX loader threads through the shuffling
+#: buffer: each row's packed ``(seq << PACK_SHIFT) | payload_offset``.
+LINEAGE_COLUMN = '_lineage_src'
+
+#: Key under which a finished loader batch exposes its
+#: :class:`BatchProvenance` (next to the existing ``'_host'`` convention).
+PROVENANCE_KEY = '_provenance'
+
+#: Bits reserved for the payload-row offset in a packed source id. Row
+#: groups are far below 16M rows, so ``seq`` keeps 39 effective bits.
+PACK_SHIFT = 24
+_OFFSET_MASK = (1 << PACK_SHIFT) - 1
+
+#: Registered provenance records kept in the tracker's ring.
+DEFAULT_RECORD_CAPACITY = 65536
+
+#: Per-epoch ledgers kept before the oldest epoch is evicted (bounds
+#: ``num_epochs=None`` streams).
+DEFAULT_EPOCH_CAPACITY = 16
+
+#: Quarantine records kept in the ring (totals keep counting past it).
+DEFAULT_QUARANTINE_CAPACITY = 1024
+
+#: Valid ``on_decode_error`` policies.
+DECODE_ERROR_POLICIES = ('raise', 'skip', 'quarantine')
+
+#: Exception classes that stay loud under EVERY ``on_decode_error`` policy —
+#: they signal infrastructure failure (storage, memory, interpreter
+#: shutdown), not a bad sample. Shared by the item-level quarantine gate and
+#: the cell-level tolerant decode loop.
+NEVER_QUARANTINE = (OSError, MemoryError, KeyboardInterrupt, SystemExit)
+
+
+def lineage_enabled() -> bool:
+    """The :data:`LINEAGE_ENV_VAR` gate (default on)."""
+    value = os.environ.get(LINEAGE_ENV_VAR, '').strip().lower()
+    return value not in ('0', 'false', 'off')
+
+
+def validate_decode_error_policy(policy: str) -> str:
+    if policy not in DECODE_ERROR_POLICIES:
+        raise ValueError('on_decode_error must be one of {}, got {!r}'.format(
+            DECODE_ERROR_POLICIES, policy))
+    return policy
+
+
+class Provenance(NamedTuple):
+    """Compact per-item provenance: where the rows of one published result
+    came from. Plain data end to end — pickles across the process-pool
+    boundary in the control frame and JSON-ifies via :meth:`_asdict`.
+
+    ``selection`` describes which source rows (file-order offsets within the
+    row group) the payload carries:
+
+    - ``('all', n)`` — all ``n`` rows, in file order.
+    - ``('slice', lo, hi)`` — rows ``[lo, hi)`` (shuffle_row_drop partition).
+    - ``('index', (o0, o1, ...))`` — explicit offsets (predicate matches,
+      or a contiguous range with quarantined rows dropped).
+    - ``('windows', n)`` — ``n`` NGram windows (window-, not row-granular).
+    - ``('opaque', n)`` — ``n`` rows whose source offsets are unknowable
+      (local-cache hit, or a transform that changed the row count).
+    """
+    dataset: str        # short dataset-path digest (12 hex chars)
+    file_index: int     # ordinal of `path` among the reader's files
+    path: str           # absolute path on the dataset filesystem
+    row_group: int      # row-group ordinal within the file
+    rows: int           # rows (or windows) this payload delivers
+    selection: tuple
+    epoch: int          # ventilation epoch the item belongs to
+    shard: int          # reader shard (cur_shard), -1 when unsharded
+    piece_index: int    # ventilation piece ordinal (the replay handle)
+    partition: tuple    # shuffle_row_drop_partition (k, n)
+    worker_id: int      # worker that produced the payload
+
+
+class LineageEnvelope:
+    """In-band carrier wrapping one published payload with its provenance
+    (thread/dummy pools; the process pool moves the record in the control
+    frame instead so payload frames stay zero-copy)."""
+
+    __slots__ = ('payload', 'provenance')
+
+    def __init__(self, payload, provenance: Provenance):
+        self.payload = payload
+        self.provenance = provenance
+
+
+def batch_provenance_of(batch) -> Optional['BatchProvenance']:
+    """The :class:`BatchProvenance` of a loader batch dict — top-level for
+    host batches, under ``'_host'`` for staged/sharded ones (keeping every
+    other top-level entry a ``jax.Array``). ``None`` when absent."""
+    if not isinstance(batch, dict):
+        return None
+    value = batch.get(PROVENANCE_KEY)
+    if value is None:
+        value = (batch.get('_host') or {}).get(PROVENANCE_KEY) \
+            if isinstance(batch.get('_host'), dict) else None
+    return value if isinstance(value, BatchProvenance) else None
+
+
+def unwrap_envelope(item, tracker: Optional['LineageTracker']):
+    """``(payload, seq-or-None)`` of a pool result: envelopes are unwrapped
+    and registered with ``tracker`` (when given), raw payloads pass through."""
+    if isinstance(item, LineageEnvelope):
+        seq = tracker.register(item.provenance) if tracker is not None else None
+        return item.payload, seq
+    return item, None
+
+
+def pack_source(seq: int, offset: int) -> int:
+    """One packed int64 source id for row ``offset`` of registered item
+    ``seq``."""
+    return (seq << PACK_SHIFT) | (offset & _OFFSET_MASK)
+
+
+def pack_rows(seq: int, n: int) -> np.ndarray:
+    """Packed source ids for all ``n`` payload rows of item ``seq`` — the
+    vectorized per-chunk form (one numpy op, no per-row Python)."""
+    return (seq << PACK_SHIFT) + np.arange(n, dtype=np.int64)
+
+
+def unpack_source(packed: int) -> Tuple[int, int]:
+    return int(packed) >> PACK_SHIFT, int(packed) & _OFFSET_MASK
+
+
+def selection_offsets(selection: tuple) -> Optional[np.ndarray]:
+    """Source row offsets a selection covers (``None`` when not
+    row-transparent)."""
+    kind = selection[0]
+    if kind == 'all':
+        return np.arange(selection[1], dtype=np.int64)
+    if kind == 'slice':
+        return np.arange(selection[1], selection[2], dtype=np.int64)
+    if kind == 'index':
+        return np.asarray(selection[1], dtype=np.int64)
+    return None
+
+
+class LineageTracker:
+    """Consumer-side lineage ledger of one reader.
+
+    Holds (all ring-bounded):
+
+    - the provenance **record ring**: ``seq -> Provenance`` for every
+      registered (delivered) item — what ``batch['_provenance']`` and
+      :func:`replay` resolve against;
+    - per-epoch **ventilation** and **delivery ledgers** keyed by
+      ``(piece_index, partition)`` — what :class:`CoverageAuditor` compares;
+    - the **quarantine ring** plus running totals.
+
+    Thread-safe: the ventilator thread records ventilations, the consumer
+    thread registers deliveries, pools push quarantines.
+    """
+
+    def __init__(self, enabled: bool = True, dataset_digest: str = '',
+                 shard: int = -1,
+                 pieces: Optional[List[Tuple[str, int, int]]] = None,
+                 items: Optional[List[Tuple[int, tuple]]] = None,
+                 row_filtered: bool = False,
+                 record_capacity: int = DEFAULT_RECORD_CAPACITY,
+                 epoch_capacity: int = DEFAULT_EPOCH_CAPACITY,
+                 quarantine_capacity: int = DEFAULT_QUARANTINE_CAPACITY):
+        self.enabled = enabled
+        self.dataset_digest = dataset_digest
+        self.shard = shard
+        #: True when a predicate/filters legitimately drop rows — row
+        #: coverage is then checked for duplicates only, never for misses.
+        self.row_filtered = row_filtered
+        #: ``piece_index -> (path, row_group, num_rows)`` — the audit's
+        #: source-of-truth for row-exact coverage (num_rows from footers).
+        self.pieces = {i: tuple(p) for i, p in enumerate(pieces or [])}
+        #: The full per-epoch item universe ``[(piece_index, partition)]``.
+        self.items = [(int(i), tuple(p)) for i, p in (items or [])]
+        self._record_capacity = record_capacity
+        self._epoch_capacity = epoch_capacity
+        self._lock = threading.Lock()
+        self._records: 'collections.OrderedDict[int, Provenance]' = \
+            collections.OrderedDict()
+        self._next_seq = 0
+        # epoch -> {'ventilated': Counter, 'vent_order': [key],
+        #           'delivered': {key: [Provenance]}, 'order': [key],
+        #           'rows': int}
+        self._epochs: 'collections.OrderedDict[int, dict]' = \
+            collections.OrderedDict()
+        self._quarantines: 'collections.deque' = collections.deque(
+            maxlen=quarantine_capacity)
+        self.quarantined_rows_total = 0
+        self.quarantined_items_total = 0
+        self.records_registered = 0
+        self.passes = 0
+
+    # -- ledgers ---------------------------------------------------------------
+
+    def _epoch_entry(self, epoch: int) -> dict:
+        entry = self._epochs.get(epoch)
+        if entry is None:
+            entry = {'ventilated': collections.Counter(), 'vent_order': [],
+                     'delivered': {}, 'order': [], 'rows': 0,
+                     'quarantined': collections.Counter()}
+            self._epochs[epoch] = entry
+            while len(self._epochs) > self._epoch_capacity:
+                self._epochs.popitem(last=False)
+        return entry
+
+    def record_ventilated(self, epoch: int, piece_index: int,
+                          partition: tuple) -> None:
+        """Called from the reader's ventilate wrapper: one work item was
+        handed to the pool for ``epoch``."""
+        if not self.enabled or piece_index is None:
+            return
+        key = (piece_index, tuple(partition or (0, 1)))
+        with self._lock:
+            entry = self._epoch_entry(epoch)
+            entry['ventilated'][key] += 1
+            entry['vent_order'].append(key)
+
+    def register(self, record: Provenance) -> int:
+        """Register one delivered item's provenance; returns its ``seq``
+        (the handle packed into batch source ids)."""
+        key = (record.piece_index, tuple(record.partition))
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            self._records[seq] = record
+            while len(self._records) > self._record_capacity:
+                self._records.popitem(last=False)
+            entry = self._epoch_entry(record.epoch)
+            entry['delivered'].setdefault(key, []).append(record)
+            entry['order'].append(key)
+            entry['rows'] += record.rows
+            self.records_registered += 1
+        return seq
+
+    def resolve(self, seq) -> Optional[Provenance]:
+        """The provenance registered as ``seq`` (``None`` if ring-evicted)."""
+        if seq is None:
+            return None
+        with self._lock:
+            return self._records.get(int(seq))
+
+    def add_quarantines(self, records) -> None:
+        """Absorb quarantine records shipped back by a pool."""
+        if not records:
+            return
+        with self._lock:
+            for record in records:
+                self._quarantines.append(record)
+                rows = int(record.get('rows', 1))
+                self.quarantined_rows_total += rows
+                self.quarantined_items_total += 1
+                epoch = record.get('epoch')
+                if epoch is not None:
+                    key = (record.get('piece_index', -1),
+                           tuple(record.get('partition') or (0, 1)))
+                    self._epoch_entry(int(epoch))['quarantined'][key] += rows
+
+    def quarantines(self, limit: Optional[int] = None) -> List[dict]:
+        """The most recent quarantine records (ring-bounded)."""
+        with self._lock:
+            records = list(self._quarantines)
+        return records[-limit:] if limit else records
+
+    def start_pass(self) -> None:
+        """Mark a ``Reader.reset()`` boundary. Epoch numbers are globally
+        monotone across passes (the ventilator never rewinds its epoch
+        counter), so every pass audits against fresh per-epoch ledgers —
+        this only records that a new pass began."""
+        with self._lock:
+            self.passes += 1
+
+    # -- views -----------------------------------------------------------------
+
+    def epochs(self) -> List[int]:
+        with self._lock:
+            return sorted(self._epochs)
+
+    def epoch_ledger(self, epoch: int) -> Optional[dict]:
+        """A point-in-time deep-enough copy of one epoch's ledgers."""
+        with self._lock:
+            entry = self._epochs.get(epoch)
+            if entry is None:
+                return None
+            return {'ventilated': dict(entry['ventilated']),
+                    'vent_order': list(entry['vent_order']),
+                    'delivered': {k: list(v)
+                                  for k, v in entry['delivered'].items()},
+                    'order': list(entry['order']),
+                    'rows': entry['rows'],
+                    'quarantined': dict(entry['quarantined'])}
+
+    def coverage_report(self) -> dict:
+        """The full :class:`CoverageAuditor` report (the ``/coverage``
+        debug-endpoint payload)."""
+        return CoverageAuditor(self).report()
+
+    def flight_summary(self, quarantine_limit: int = 20) -> dict:
+        """The condensed lineage section embedded in flight records."""
+        report = self.coverage_report()
+        report['recent_quarantines'] = self.quarantines(quarantine_limit)
+        return report
+
+
+class CoverageAuditor:
+    """Audits a :class:`LineageTracker`'s ledgers: exactly-once delivery per
+    epoch per shard, with duplicates/drops named by source row group, plus
+    shuffle-quality and inter-shard skew metrics."""
+
+    def __init__(self, tracker: LineageTracker):
+        self._tracker = tracker
+
+    def _piece_brief(self, piece_index: int, partition: tuple) -> dict:
+        info = self._tracker.pieces.get(piece_index)
+        brief = {'piece_index': piece_index, 'partition': list(partition)}
+        if info is not None:
+            brief.update({'path': info[0], 'row_group': info[1],
+                          'num_rows': info[2]})
+        return brief
+
+    def audit_epoch(self, epoch: int) -> Optional[dict]:
+        """One epoch's verdict: item-exactness (delivered == ventilated,
+        dups/drops named), row-exactness (union of selections + quarantined
+        offsets covers each row group exactly once — checked only when every
+        selection is row-transparent), and the shuffle-lag distribution."""
+        ledger = self._tracker.epoch_ledger(epoch)
+        if ledger is None:
+            return None
+        ventilated = ledger['ventilated']
+        delivered = ledger['delivered']
+        quarantined = ledger['quarantined']
+        dup_items, dropped_items, quarantined_items = [], [], []
+        for key, count in sorted(ventilated.items()):
+            got = len(delivered.get(key, ()))
+            if got > count:
+                dup_items.append(dict(self._piece_brief(*key),
+                                      ventilated=count, delivered=got))
+            elif got < count:
+                if quarantined.get(key):
+                    # every row of the item was quarantined/skipped: the
+                    # item is accounted for, not silently dropped
+                    quarantined_items.append(dict(
+                        self._piece_brief(*key), ventilated=count,
+                        delivered=got,
+                        rows_quarantined=int(quarantined[key])))
+                else:
+                    dropped_items.append(dict(self._piece_brief(*key),
+                                              ventilated=count, delivered=got))
+        for key in sorted(set(delivered) - set(ventilated)):
+            dup_items.append(dict(self._piece_brief(*key), ventilated=0,
+                                  delivered=len(delivered[key])))
+
+        # -- row-exactness: per piece, the union of delivered selections
+        # plus quarantined rows must cover [0, num_rows) exactly once
+        row_exact = True
+        row_dups = row_missing = 0
+        check_missing = not self._tracker.row_filtered
+        by_piece: Dict[int, List] = {}
+        for (piece_index, _partition), records in delivered.items():
+            by_piece.setdefault(piece_index, []).extend(records)
+        for piece_index, records in by_piece.items():
+            info = self._tracker.pieces.get(piece_index)
+            num_rows = info[2] if info else -1
+            sels = [selection_offsets(r.selection) for r in records]
+            if any(s is None for s in sels):
+                row_exact = False
+                continue
+            covered = (np.concatenate(sels) if sels
+                       else np.empty(0, np.int64))
+            unique = np.unique(covered)
+            row_dups += int(len(covered) - len(unique))
+            if check_missing and num_rows is not None and num_rows >= 0:
+                q_rows = sum(n for (pi, _p), n in quarantined.items()
+                             if pi == piece_index)
+                row_missing += max(0, int(num_rows - len(unique) - q_rows))
+            elif check_missing:
+                row_exact = False
+        if not check_missing:
+            row_exact = False
+
+        lags = self._shuffle_lags(ledger)
+        out = {
+            'epoch': epoch,
+            'items_expected': len(self._tracker.items) or None,
+            'items_ventilated': sum(ventilated.values()),
+            'items_delivered': sum(len(v) for v in delivered.values()),
+            'rows_delivered': ledger['rows'],
+            'rows_quarantined': int(sum(quarantined.values())),
+            'dup_items': dup_items,
+            'dropped_items': dropped_items,
+            'quarantined_items': quarantined_items,
+            'row_exact': row_exact,
+            'row_dups': row_dups,
+            'row_missing': row_missing,
+            'complete': (not dup_items and not dropped_items
+                         and row_dups == 0
+                         and (not row_exact or row_missing == 0)),
+            'shuffle': lags,
+        }
+        return out
+
+    @staticmethod
+    def _shuffle_lags(ledger: dict) -> dict:
+        """Item-level shuffle quality: |arrival position - ventilation
+        position| per item (lag), plus run lengths of consecutive arrivals
+        from the same source file-piece."""
+        vent_pos = {}
+        for pos, key in enumerate(ledger['vent_order']):
+            vent_pos.setdefault(key, []).append(pos)
+        lags = []
+        taken: Dict[tuple, int] = {}
+        for pos, key in enumerate(ledger['order']):
+            positions = vent_pos.get(key)
+            if not positions:
+                continue
+            i = min(taken.get(key, 0), len(positions) - 1)
+            taken[key] = i + 1
+            lags.append(abs(pos - positions[i]))
+        runs, current = [], 0
+        last_piece = None
+        for key in ledger['order']:
+            if key[0] == last_piece:
+                current += 1
+            else:
+                if current:
+                    runs.append(current)
+                current = 1
+                last_piece = key[0]
+        if current:
+            runs.append(current)
+        if not lags:
+            return {'items': 0}
+        lags_arr = np.asarray(lags)
+        runs_arr = np.asarray(runs) if runs else np.asarray([0])
+        return {
+            'items': len(lags),
+            'lag_mean': round(float(lags_arr.mean()), 3),
+            'lag_p50': int(np.median(lags_arr)),
+            'lag_max': int(lags_arr.max()),
+            'adjacent_source_runs': len(runs),
+            'run_length_mean': round(float(runs_arr.mean()), 3),
+            'run_length_max': int(runs_arr.max()),
+        }
+
+    def report(self) -> dict:
+        """The full audit: per-epoch verdicts plus totals. ``complete`` is
+        the AND over audited epochs (an epoch still in flight reads as
+        incomplete until its last item is delivered — audit after
+        consumption)."""
+        tracker = self._tracker
+        epochs = {}
+        for epoch in tracker.epochs():
+            verdict = self.audit_epoch(epoch)
+            if verdict is not None:
+                epochs[epoch] = verdict
+        return {
+            'enabled': tracker.enabled,
+            'dataset': tracker.dataset_digest,
+            'shard': tracker.shard,
+            'passes': tracker.passes,
+            'records_registered': tracker.records_registered,
+            'rows_quarantined_total': tracker.quarantined_rows_total,
+            'items_quarantined_total': tracker.quarantined_items_total,
+            'epochs': epochs,
+            'complete': all(v['complete'] for v in epochs.values())
+            if epochs else None,
+        }
+
+    def assert_complete(self) -> dict:
+        """Raise ``AssertionError`` (naming the offending row groups) unless
+        every audited epoch delivered exactly once; returns the report."""
+        report = self.report()
+        problems = []
+        for epoch, verdict in report['epochs'].items():
+            if verdict['dropped_items']:
+                problems.append('epoch {}: dropped {}'.format(
+                    epoch, verdict['dropped_items']))
+            if verdict['dup_items']:
+                problems.append('epoch {}: duplicated {}'.format(
+                    epoch, verdict['dup_items']))
+            if verdict['row_exact'] and (verdict['row_dups']
+                                         or verdict['row_missing']):
+                problems.append('epoch {}: {} duplicate / {} missing rows'
+                                .format(epoch, verdict['row_dups'],
+                                        verdict['row_missing']))
+        if problems:
+            raise AssertionError('coverage audit failed: ' +
+                                 '; '.join(problems))
+        return report
+
+    @staticmethod
+    def shard_skew(reports: List[dict]) -> dict:
+        """Inter-shard skew across per-shard coverage reports (one reader
+        per shard): rows delivered per shard per epoch and the max/min
+        imbalance ratio."""
+        per_shard = {}
+        epochs = set()
+        for report in reports:
+            shard = report.get('shard', -1)
+            rows = {int(e): v['rows_delivered']
+                    for e, v in report.get('epochs', {}).items()}
+            per_shard[shard] = rows
+            epochs.update(rows)
+        skew = {}
+        for epoch in sorted(epochs):
+            rows = [per_shard[s].get(epoch, 0) for s in sorted(per_shard)]
+            low = min(rows)
+            skew[epoch] = {
+                'rows_per_shard': {s: per_shard[s].get(epoch, 0)
+                                   for s in sorted(per_shard)},
+                'skew_ratio': round(max(rows) / low, 4) if low else None,
+            }
+        return {'shards': sorted(per_shard), 'epochs': skew}
+
+
+class BatchProvenance:
+    """Row-level provenance of one assembled loader batch.
+
+    Wraps the packed int64 source column that rode through the shuffling
+    buffer: row ``i`` of the batch came from payload offset
+    ``sources[i] & OFFSET_MASK`` of registered item ``sources[i] >> SHIFT``.
+    Resolution back to :class:`Provenance` records is lazy (the hot path
+    never touches Python objects per row)."""
+
+    __slots__ = ('sources', '_tracker')
+
+    def __init__(self, sources: np.ndarray, tracker: Optional[LineageTracker]):
+        self.sources = np.asarray(sources, dtype=np.int64)
+        self._tracker = tracker
+
+    def __len__(self) -> int:
+        return len(self.sources)
+
+    def seqs(self) -> np.ndarray:
+        return self.sources >> PACK_SHIFT
+
+    def offsets(self) -> np.ndarray:
+        return self.sources & _OFFSET_MASK
+
+    def record_for_row(self, i: int) -> Optional[Provenance]:
+        if self._tracker is None:
+            return None
+        return self._tracker.resolve(int(self.sources[i]) >> PACK_SHIFT)
+
+    def records(self) -> Dict[int, Optional[Provenance]]:
+        """``seq -> Provenance`` for every distinct source item in the batch
+        (``None`` values mark ring-evicted records)."""
+        out = {}
+        if self._tracker is None:
+            return out
+        for seq in np.unique(self.seqs()):
+            out[int(seq)] = self._tracker.resolve(int(seq))
+        return out
+
+    def shuffle_quality(self) -> dict:
+        """Row-level shuffle quality of this batch: adjacent-source run
+        lengths (runs of consecutive rows from the same source item — long
+        runs mean the shuffle buffer is too small to decorrelate row-group
+        order) and distinct-source count."""
+        seqs = self.seqs()
+        if not len(seqs):
+            return {'rows': 0}
+        boundaries = np.flatnonzero(np.diff(seqs) != 0)
+        run_lengths = np.diff(np.concatenate(
+            ([0], boundaries + 1, [len(seqs)])))
+        return {
+            'rows': int(len(seqs)),
+            'sources': int(len(np.unique(seqs))),
+            'adjacent_source_runs': int(len(run_lengths)),
+            'run_length_mean': round(float(run_lengths.mean()), 3),
+            'run_length_max': int(run_lengths.max()),
+        }
+
+    def summary(self) -> dict:
+        """JSON-able description: per-source row counts with their resolved
+        provenance — the human-readable answer to "where did this batch's
+        rows come from"."""
+        seqs = self.seqs()
+        sources = []
+        for seq, count in zip(*np.unique(seqs, return_counts=True)):
+            record = (self._tracker.resolve(int(seq))
+                      if self._tracker is not None else None)
+            entry = {'seq': int(seq), 'rows': int(count)}
+            if record is not None:
+                entry.update({'path': record.path,
+                              'row_group': record.row_group,
+                              'epoch': record.epoch,
+                              'shard': record.shard,
+                              'selection': list(record.selection[:1]) +
+                              [int(x) if isinstance(x, (int, np.integer))
+                               else list(x) for x in record.selection[1:]]})
+            else:
+                entry['evicted'] = True
+            sources.append(entry)
+        return {'rows': int(len(seqs)), 'sources': sources,
+                'shuffle': self.shuffle_quality()}
+
+
+# -- quarantine records -------------------------------------------------------
+
+def make_quarantine_record(piece, piece_index: int, epoch: int,
+                           partition: tuple, shard: int, stage: str,
+                           error: BaseException, field: Optional[str] = None,
+                           rows: int = 1,
+                           row_offsets=None) -> dict:
+    """One JSON-able quarantine record (what pools ship back and the tracker
+    rings)."""
+    record = {
+        'stage': stage,
+        'error': '{}: {}'.format(type(error).__name__, error)[:500],
+        'path': piece.path,
+        'row_group': piece.row_group,
+        'piece_index': piece_index,
+        'epoch': epoch,
+        'partition': list(partition),
+        'shard': shard,
+        'rows': int(rows),
+        'ts': time.time(),
+    }
+    if field is not None:
+        record['field'] = field
+    if row_offsets is not None:
+        record['row_offsets'] = [int(o) for o in row_offsets]
+    return record
+
+
+# -- replay -------------------------------------------------------------------
+
+class _ReplayCollector:
+    """Publish sink of the replay worker."""
+
+    def __init__(self):
+        self.items = []
+
+    def __call__(self, payload):
+        self.items.append(payload)
+
+
+def _payload_to_columns(payload, schema) -> Dict[str, np.ndarray]:
+    """Normalize any worker payload (row-dict list, column dict, arrow
+    table) into a dict of numpy column arrays in payload-row order."""
+    import pyarrow as pa
+    if isinstance(payload, pa.Table):
+        from petastorm_tpu.readers.batch_worker import BatchResultsReader
+        out = {}
+        for name in payload.column_names:
+            field = schema.fields.get(name) if schema is not None else None
+            column = payload.column(name)
+            if field is not None:
+                out[name] = BatchResultsReader._column_to_numpy(column, field)
+            else:
+                out[name] = column.to_numpy(zero_copy_only=False)
+        return out
+    if isinstance(payload, dict):
+        return {k: np.asarray(v) if not isinstance(v, np.ndarray) else v
+                for k, v in payload.items()}
+    if isinstance(payload, list):   # row dicts
+        from petastorm_tpu.jax_utils import JaxDataLoader
+        return JaxDataLoader._collate(payload) if payload else {}
+    raise TypeError('cannot replay payload of type {}'.format(type(payload)))
+
+
+def replay_records(reader, records: List[Provenance],
+                   offsets_per_record: Optional[List[np.ndarray]] = None
+                   ) -> Dict[str, np.ndarray]:
+    """Re-fetch the exact rows of ``records`` through the reader's own
+    worker machinery (same predicate/partition/decode path) and return them
+    as a dict of numpy columns, concatenated in record order.
+
+    ``offsets_per_record`` optionally selects payload-row offsets per record
+    (what :func:`replay` uses to reassemble a batch bit-exactly)."""
+    worker_class = getattr(reader, '_worker_class', None)
+    worker_args = getattr(reader, '_worker_args', None)
+    replay_items = getattr(reader, '_replay_items', None)
+    if worker_class is None or worker_args is None:
+        raise RuntimeError('reader does not expose replay machinery')
+    args = dict(worker_args)
+    args.update(trace=False, health=False, lineage=False, io_readahead=0)
+    collector = _ReplayCollector()
+    worker = worker_class(-1, collector, args)
+    pieces_out = []
+    try:
+        for i, record in enumerate(records):
+            if record is None:
+                raise ValueError('cannot replay an evicted provenance record '
+                                 '(raise the tracker record capacity)')
+            if record.selection[0] == 'windows':
+                raise NotImplementedError(
+                    'replay of NGram window provenance is not supported')
+            key = (record.piece_index, tuple(record.partition))
+            item = (replay_items or {}).get(key, {})
+            collector.items = []
+            worker.process(record.piece_index,
+                           worker_predicate=item.get('worker_predicate'),
+                           shuffle_row_drop_partition=tuple(record.partition),
+                           epoch=record.epoch)
+            if len(collector.items) != 1:
+                raise RuntimeError(
+                    'replay of {}:{} published {} payloads (expected 1)'
+                    .format(record.path, record.row_group,
+                            len(collector.items)))
+            columns = _payload_to_columns(collector.items[0],
+                                          getattr(reader, 'schema', None))
+            if offsets_per_record is not None:
+                offsets = np.asarray(offsets_per_record[i], dtype=np.int64)
+                columns = {k: v[offsets] for k, v in columns.items()}
+            pieces_out.append(columns)
+    finally:
+        worker.shutdown()
+    if not pieces_out:
+        return {}
+    if len(pieces_out) == 1:
+        return pieces_out[0]
+    keys = pieces_out[0].keys()
+    out = {}
+    for k in keys:
+        parts = [p[k] for p in pieces_out]
+        if any(p.dtype == object for p in parts):
+            # mixed dense/object parts (e.g. a nullable field whose nulls
+            # all fell in one row group): insert row-wise, never broadcast
+            col = np.empty(sum(len(p) for p in parts), dtype=object)
+            pos = 0
+            for p in parts:
+                for j in range(len(p)):
+                    col[pos + j] = p[j]
+                pos += len(p)
+            out[k] = col
+        else:
+            out[k] = np.concatenate(parts)
+    return out
+
+
+def replay(reader, provenance) -> Dict[str, np.ndarray]:
+    """Bit-exact re-fetch of recorded provenance through the reader's own
+    row-group machinery.
+
+    ``provenance`` may be a :class:`Provenance` record (returns all of that
+    item's rows), a registered ``seq`` int, a list of either, a
+    :class:`BatchProvenance`, or a loader batch dict carrying one under
+    ``'_provenance'`` — the latter two reassemble the exact batch rows in
+    the exact batch order."""
+    tracker = getattr(reader, 'lineage', None)
+    if isinstance(provenance, dict):
+        provenance = batch_provenance_of(provenance) or provenance
+    if isinstance(provenance, BatchProvenance):
+        seqs = provenance.seqs()
+        offsets = provenance.offsets()
+        order = np.arange(len(seqs))
+        unique_seqs = np.unique(seqs)
+        records, offset_lists, positions = [], [], []
+        for seq in unique_seqs:
+            mask = seqs == seq
+            record = tracker.resolve(int(seq)) if tracker is not None else None
+            records.append(record)
+            offset_lists.append(offsets[mask])
+            positions.append(order[mask])
+        columns = replay_records(reader, records, offset_lists)
+        # reassemble in batch order: rows were concatenated per unique seq
+        perm = np.concatenate(positions) if positions else np.empty(0, np.int64)
+        inverse = np.empty(len(perm), dtype=np.int64)
+        inverse[perm] = np.arange(len(perm))
+        return {k: v[inverse] for k, v in columns.items()}
+    if isinstance(provenance, Provenance):
+        return replay_records(reader, [provenance])
+    if isinstance(provenance, (int, np.integer)):
+        record = tracker.resolve(int(provenance)) if tracker is not None \
+            else None
+        return replay_records(reader, [record])
+    if isinstance(provenance, (list, tuple)):
+        records = [tracker.resolve(int(p)) if isinstance(p, (int, np.integer))
+                   else p for p in provenance]
+        return replay_records(reader, records)
+    raise TypeError('cannot replay {!r}'.format(type(provenance)))

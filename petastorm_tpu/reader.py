@@ -36,6 +36,11 @@ from petastorm_tpu.health import (DEFAULT_STALL_AFTER_S, DebugServer,
                                   HealthMonitor, PipelineWatchdog,
                                   build_flight_record, resolve_debug_port,
                                   write_flight_record)
+from petastorm_tpu.lineage import (BatchProvenance, CoverageAuditor,
+                                   LineageTracker, batch_provenance_of,
+                                   lineage_enabled, unwrap_envelope,
+                                   validate_decode_error_policy)
+from petastorm_tpu.lineage import replay as _lineage_replay
 from petastorm_tpu.ngram import NGram
 from petastorm_tpu.predicates import in_reduce
 from petastorm_tpu.readers.batch_worker import ArrowBatchWorker, BatchResultsReader
@@ -156,7 +161,7 @@ def make_reader(dataset_url,
                 profiling_enabled=False, decode_hints=None,
                 io_readahead=0, trace=None, metrics_interval=0,
                 metrics_out=None, debug_port=None, stall_timeout=0,
-                flight_record_dir=None):
+                flight_record_dir=None, on_decode_error='raise'):
     """Row-granular reader for petastorm_tpu datasets (codec-decoded rows).
 
     Mirrors the reference factory (``reader.py:61-195``). Raises a helpful error
@@ -188,6 +193,14 @@ def make_reader(dataset_url,
     that classifies the pipeline from per-entity heartbeats and writes a
     flight-recorder JSON into ``flight_record_dir`` when no entity has made
     progress for S seconds. See ``docs/health.md``.
+
+    Every yielded item carries sample lineage by default (``reader.lineage``
+    ledgers, ``reader.explain_batch()``, ``reader.replay()``, the
+    ``/coverage`` debug route; kill switch ``PETASTORM_TPU_LINEAGE=0``).
+    ``on_decode_error`` picks the bad-sample policy: ``'raise'`` (default)
+    propagates decode/transform exceptions, ``'skip'`` drops the failing
+    rows counting them, ``'quarantine'`` drops them AND records
+    provenance-tagged quarantine records. See ``docs/lineage.md``.
     """
     dataset_url = normalize_dataset_url_or_urls(dataset_url)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url, storage_options)
@@ -223,7 +236,8 @@ def make_reader(dataset_url,
                   io_readahead=io_readahead, trace_export=trace_export,
                   metrics_interval=metrics_interval, metrics_out=metrics_out,
                   debug_port=debug_port, stall_timeout=stall_timeout,
-                  flight_record_dir=flight_record_dir)
+                  flight_record_dir=flight_record_dir,
+                  on_decode_error=on_decode_error)
 
 
 def make_columnar_reader(dataset_url,
@@ -242,7 +256,7 @@ def make_columnar_reader(dataset_url,
                          profiling_enabled=False, decode_hints=None,
                          io_readahead=0, trace=None, metrics_interval=0,
                          metrics_out=None, debug_port=None, stall_timeout=0,
-                         flight_record_dir=None):
+                         flight_record_dir=None, on_decode_error='raise'):
     """Vectorized codec-decoded reader for petastorm_tpu datasets.
 
     Yields **batch namedtuples of decoded numpy column arrays** (one per row
@@ -293,7 +307,8 @@ def make_columnar_reader(dataset_url,
                   io_readahead=io_readahead, trace_export=trace_export,
                   metrics_interval=metrics_interval, metrics_out=metrics_out,
                   debug_port=debug_port, stall_timeout=stall_timeout,
-                  flight_record_dir=flight_record_dir)
+                  flight_record_dir=flight_record_dir,
+                  on_decode_error=on_decode_error)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -309,7 +324,8 @@ def make_batch_reader(dataset_url_or_urls,
                       storage_options=None, zmq_copy_buffers=True,
                       profiling_enabled=False, io_readahead=0, trace=None,
                       metrics_interval=0, metrics_out=None, debug_port=None,
-                      stall_timeout=0, flight_record_dir=None):
+                      stall_timeout=0, flight_record_dir=None,
+                      on_decode_error='raise'):
     """Vectorized batch reader for arbitrary parquet stores
     (reference ``reader.py:198-327``). Yields namedtuples of column arrays,
     one per row group. ``io_readahead`` prefetches upcoming row-group reads
@@ -344,7 +360,8 @@ def make_batch_reader(dataset_url_or_urls,
                   trace_export=trace_export, metrics_interval=metrics_interval,
                   metrics_out=metrics_out, debug_port=debug_port,
                   stall_timeout=stall_timeout,
-                  flight_record_dir=flight_record_dir)
+                  flight_record_dir=flight_record_dir,
+                  on_decode_error=on_decode_error)
 
 
 class Reader:
@@ -359,7 +376,7 @@ class Reader:
                  pool=None, is_batched_reader=False, decode_hints=None,
                  io_readahead=0, trace_export=None, metrics_interval=0,
                  metrics_out=None, debug_port=None, stall_timeout=0,
-                 flight_record_dir=None):
+                 flight_record_dir=None, on_decode_error='raise'):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -374,6 +391,7 @@ class Reader:
         if stall_timeout and stall_timeout < 0:
             raise ValueError('stall_timeout must be >= 0, got '
                              '{!r}'.format(stall_timeout))
+        validate_decode_error_policy(on_decode_error)
         self._filesystem_factory = filesystem_factory
         self._dataset_path = dataset_path
         self._pool = pool
@@ -487,22 +505,62 @@ class Reader:
                          else io_readahead)
         else:
             lookahead = 0
+        # -- sample lineage (see docs/lineage.md) ------------------------------
+        import hashlib
+        dataset_digest = hashlib.md5(
+            str(dataset_path).encode()).hexdigest()[:12]
+        #: The reader's :class:`~petastorm_tpu.lineage.LineageTracker`:
+        #: per-item provenance records, per-epoch ventilated/delivered
+        #: ledgers, quarantine ring. ``reader.lineage.coverage_report()``
+        #: audits delivery; disabled (but present) under
+        #: ``PETASTORM_TPU_LINEAGE=0``.
+        self.lineage = LineageTracker(
+            enabled=lineage_enabled(),
+            dataset_digest=dataset_digest,
+            shard=cur_shard if cur_shard is not None else -1,
+            pieces=[(p.path, p.row_group, p.num_rows) for p in pieces],
+            items=[(it['piece_index'],
+                    tuple(it['shuffle_row_drop_partition'])) for it in items],
+            row_filtered=(worker_predicate is not None
+                          or filters_predicate is not None))
+        self._worker_class = worker_class
+        self._replay_items = {
+            (it['piece_index'], tuple(it['shuffle_row_drop_partition'])): it
+            for it in items}
+
         tracer = getattr(pool, 'tracer', None)
         ventilate_fn = pool.ventilate
+        if self.lineage.enabled:
+            # the ventilation ledger is the audit's "expected" side: what was
+            # dispatched but never delivered is a DROP, not a mystery
+            record_ventilated = self.lineage.record_ventilated
+            inner_ventilate = ventilate_fn
+
+            def ventilate_fn(*v_args, **v_kwargs):
+                record_ventilated(
+                    v_kwargs.get('epoch', 0), v_kwargs.get('piece_index'),
+                    v_kwargs.get('shuffle_row_drop_partition', (0, 1)))
+                inner_ventilate(*v_args, **v_kwargs)
         if tracer is not None:
+            traced_ventilate = ventilate_fn
+
             def ventilate_fn(*v_args, **v_kwargs):
                 with tracer.span('ventilate', 'ventilator'):
-                    pool.ventilate(*v_args, **v_kwargs)
+                    traced_ventilate(*v_args, **v_kwargs)
         self._ventilator = ConcurrentVentilator(
             ventilate_fn, items, iterations=num_epochs,
             randomize_item_order=shuffle_row_groups, random_seed=seed,
             max_ventilation_queue_size=(
                 pool.workers_count * (1 + lookahead) + _VENTILATE_EXTRA_ROWGROUPS),
-            heartbeat=self.health.beat if self.health.enabled else None)
+            heartbeat=self.health.beat if self.health.enabled else None,
+            epoch_key='epoch')
 
         worker_args = {
             'trace': tracer is not None,
             'health': self.health.enabled,
+            'lineage': self.lineage.enabled,
+            'on_decode_error': on_decode_error,
+            'shard': cur_shard if cur_shard is not None else -1,
             'filesystem_factory': filesystem_factory,
             'dataset_path': dataset_path,
             'schema': view_schema,
@@ -515,8 +573,10 @@ class Reader:
             'decode_hints': decode_hints,
             'io_readahead': io_readahead,
         }
+        self._worker_args = worker_args
         # fail fast on bad hints (workers rebuild these after unpickling)
         build_decode_overrides(stored_schema, decode_hints)
+        pool.lineage = self.lineage
         pool.start(worker_class, worker_args, self._ventilator)
         if metrics_interval:
             self._metrics_emitter = MetricsEmitter(
@@ -541,7 +601,9 @@ class Reader:
         if resolved_debug_port is not None:
             self._debug_server = DebugServer(
                 self._watchdog.evaluate, pool.stats.snapshot,
-                self.health.heartbeats, port=resolved_debug_port)
+                self.health.heartbeats, port=resolved_debug_port,
+                coverage_fn=(self.lineage.coverage_report
+                             if self.lineage.enabled else None))
             try:
                 self._debug_server.start()
             except (OSError, OverflowError) as e:   # taken / out-of-range port
@@ -554,7 +616,9 @@ class Reader:
                     '(%s); pass debug_port=0 for an ephemeral port per '
                     'reader', resolved_debug_port, e)
                 self._debug_server = None
-        self._results_reader = results_reader_factory(transformed_schema, self.ngram)
+        self._results_reader = results_reader_factory(transformed_schema,
+                                                      self.ngram,
+                                                      lineage=self.lineage)
         self._stopped = False
         #: True when every published NGram item is a columnar
         #: :class:`~petastorm_tpu.ngram.NGramWindowChunk` (no per-row
@@ -695,9 +759,12 @@ class Reader:
         discard = getattr(self._results_reader, 'discard_buffered', None)
         if discard is not None:
             discard()
+        tracker = self.lineage if self.lineage.enabled else None
         try:
             while True:
-                self._pool.get_results()
+                # register discarded items' provenance so the coverage audit
+                # still sees them delivered (dropped-on-purpose != dropped)
+                unwrap_envelope(self._pool.get_results(), tracker)
         except EmptyResultError:
             self.last_row_consumed = True
 
@@ -708,6 +775,9 @@ class Reader:
             raise RuntimeError(
                 'Reader.reset() is only supported after the previous epoch set was '
                 'fully consumed (in-flight row groups cannot be recalled)')
+        # epoch numbers are globally monotone (the ventilator never rewinds),
+        # so the new pass audits against fresh per-epoch ledgers
+        self.lineage.start_pass()
         self._ventilator.reset(self._num_epochs)
         self.last_row_consumed = False
 
@@ -741,13 +811,75 @@ class Reader:
             'readahead_depth': snapshot.get('readahead_depth', 0),
         }
         record = build_flight_record(verdict, self.health.heartbeats(),
-                                     snapshot, queues, tracer=self.tracer)
+                                     snapshot, queues, tracer=self.tracer,
+                                     lineage=(self.lineage.flight_summary()
+                                              if self.lineage.enabled
+                                              else None))
         if path is None:
             import tempfile
             out_dir = self._flight_record_dir or tempfile.gettempdir()
             path = os.path.join(out_dir, 'petastorm_tpu_flight_{}_{}.json'
                                 .format(os.getpid(), int(time.time())))
         return write_flight_record(path, record)
+
+    # -- lineage (see docs/lineage.md) -----------------------------------------
+
+    @property
+    def last_seq(self):
+        """Tracker seq of the most recently yielded item (``None`` until the
+        first yield or when lineage is off)."""
+        return getattr(self._results_reader, 'last_seq', None)
+
+    @property
+    def last_row_offset(self):
+        """Payload-row offset of the most recently yielded ROW within its
+        published item (row readers only; ``None`` for batched output)."""
+        return getattr(self._results_reader, 'last_row_offset', None)
+
+    @property
+    def last_provenance(self):
+        """:class:`~petastorm_tpu.lineage.Provenance` of the most recently
+        yielded item/batch (``None`` before the first yield, when lineage is
+        off, or after ring eviction)."""
+        return self.lineage.resolve(self.last_seq)
+
+    def explain_batch(self, batch=None):
+        """Human-readable provenance of a batch.
+
+        ``batch=None`` explains the most recently yielded reader item (for
+        batched readers that IS the batch: one row group). A loader batch
+        dict carrying ``'_provenance'`` (or a
+        :class:`~petastorm_tpu.lineage.BatchProvenance` directly) resolves
+        per-row: every distinct source row group with its row count,
+        selection and shuffle quality."""
+        if batch is None:
+            record = self.last_provenance
+            if record is None:
+                return {'enabled': self.lineage.enabled, 'sources': []}
+            return {'enabled': True, 'rows': record.rows,
+                    'sources': [dict(record._asdict(),
+                                     selection=list(record.selection))]}
+        if isinstance(batch, dict):
+            batch = batch_provenance_of(batch) or batch
+        if isinstance(batch, BatchProvenance):
+            return dict(batch.summary(), enabled=True)
+        raise TypeError('explain_batch needs None, a loader batch dict with '
+                        "a '_provenance' entry, or a BatchProvenance; got "
+                        '{!r}'.format(type(batch)))
+
+    def replay(self, provenance):
+        """Re-fetch the exact rows behind ``provenance`` (a
+        :class:`~petastorm_tpu.lineage.Provenance` record, a registered seq,
+        a ``BatchProvenance``, or a loader batch dict) through this reader's
+        own row-group machinery. Returns a dict of numpy columns —
+        bit-identical to the original delivery for deterministic
+        decode/transform paths. See ``docs/lineage.md``."""
+        return _lineage_replay(self, provenance)
+
+    def audit(self) -> 'CoverageAuditor':
+        """A :class:`~petastorm_tpu.lineage.CoverageAuditor` over this
+        reader's ledgers (``audit().report()`` / ``assert_complete()``)."""
+        return CoverageAuditor(self.lineage)
 
     # -- lifecycle -------------------------------------------------------------
 

@@ -15,6 +15,7 @@ from typing import List
 import numpy as np
 import pyarrow as pa
 
+from petastorm_tpu.lineage import unwrap_envelope
 from petastorm_tpu.readers.piece_worker import ParquetPieceWorker
 
 
@@ -22,16 +23,21 @@ class BatchResultsReader:
     """Consumer-side: arrow Table -> namedtuple of numpy column arrays
     (``batched_output=True``)."""
 
-    def __init__(self, schema, ngram=None):
+    def __init__(self, schema, ngram=None, lineage=None):
         assert ngram is None, 'NGram is not supported by the batch reader'
         self._schema = schema
+        self._lineage = lineage if getattr(lineage, 'enabled', False) else None
+        self.last_seq = None
+        self.last_row_offset = None
 
     @property
     def batched_output(self) -> bool:
         return True
 
     def read_next(self, pool):
-        table = pool.get_results()
+        table, seq = unwrap_envelope(pool.get_results(), self._lineage)
+        if seq is not None:
+            self.last_seq = seq
         result = {}
         for name in self._schema.fields:
             if name not in table.column_names:
@@ -59,24 +65,48 @@ class ArrowBatchWorker(ParquetPieceWorker):
     """Processes ventilated items into published ``pa.Table`` batches."""
 
     def process(self, piece_index: int, worker_predicate=None,
-                shuffle_row_drop_partition=(0, 1)):
+                shuffle_row_drop_partition=(0, 1), epoch=0):
         piece = self._split_pieces[piece_index]
-        if worker_predicate is not None:
-            table = self._load_table_with_predicate(piece, worker_predicate)
-        else:
-            cache_key = self._cache_key('batch', piece)
-            table = self._local_cache.get(cache_key, lambda: self._load_table(piece))
+        self._begin_item(piece, piece_index, epoch, shuffle_row_drop_partition)
+        try:
+            if worker_predicate is not None:
+                table = self._load_table_with_predicate(piece, worker_predicate)
+            else:
+                cache_key = self._cache_key('batch', piece)
+                table = self._local_cache.get(cache_key,
+                                              lambda: self._load_table(piece))
+        except Exception as e:  # noqa: BLE001 - policy decides
+            if not self._quarantine_item('decode', e):
+                raise
+            return
+        offsets = self._last_offsets
         if table is None or table.num_rows == 0:
+            self._finish_item_empty()
             return
         partition, num_partitions = shuffle_row_drop_partition
         if num_partitions > 1:
             bounds = np.linspace(0, table.num_rows, num_partitions + 1, dtype=int)
             table = table.slice(bounds[partition],
                                 bounds[partition + 1] - bounds[partition])
+            offsets = self._slice_offsets(offsets, bounds[partition],
+                                          bounds[partition + 1])
         if self._transform_spec is not None:
-            table = self._apply_transform(table)
+            pre_n = table.num_rows
+            try:
+                table = self._apply_transform(table)
+            except Exception as e:  # noqa: BLE001 - policy decides
+                if not self._quarantine_item('transform', e, rows=pre_n):
+                    raise
+                return
+            if table.num_rows != pre_n:
+                offsets = None   # count-changing transform: opaque mapping
         if table.num_rows:
-            self.publish_func(table)
+            self._publish_item(table,
+                               self._compact_selection(offsets,
+                                                       table.num_rows),
+                               table.num_rows)
+        else:
+            self._finish_item_empty()
 
     # -- loading ---------------------------------------------------------------
 
@@ -99,6 +129,8 @@ class ArrowBatchWorker(ParquetPieceWorker):
     def _load_table(self, piece) -> pa.Table:
         columns = self._stored_columns(list(self._schema.fields.keys()), piece)
         table = self._read_row_group(piece, columns)
+        self._last_offsets = (self._range_offsets(table.num_rows)
+                              if self._tracks_offsets else None)
         return self._append_partition_columns(table, piece)
 
     def _load_table_with_predicate(self, piece, predicate) -> pa.Table:
@@ -116,8 +148,11 @@ class ArrowBatchWorker(ParquetPieceWorker):
         mask = [predicate.do_include({f: pred_data[f][i] for f in predicate_fields})
                 for i in range(pred_table.num_rows)]
         if not any(mask):
+            self._last_offsets = None
             return None
         indices = np.nonzero(mask)[0]
+        self._last_offsets = (indices.astype(np.int64)
+                              if self._tracks_offsets else None)
         other_names = [n for n in self._schema.fields if n not in set(predicate_fields)]
         combined = pred_stored
         other_stored = self._stored_columns(other_names, piece)

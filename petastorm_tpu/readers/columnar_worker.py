@@ -20,6 +20,7 @@ from typing import Dict, Optional
 import numpy as np
 import pyarrow as pa
 
+from petastorm_tpu.lineage import NEVER_QUARANTINE, unwrap_envelope
 from petastorm_tpu.readers.piece_worker import ParquetPieceWorker
 from petastorm_tpu.utils import cast_partition_value
 
@@ -28,16 +29,21 @@ class ColumnarResultsReader:
     """Consumer-side: published dict of column arrays -> batch namedtuple
     (``batched_output=True``)."""
 
-    def __init__(self, schema, ngram=None):
+    def __init__(self, schema, ngram=None, lineage=None):
         assert ngram is None, 'NGram is not supported by the columnar reader'
         self._schema = schema
+        self._lineage = lineage if getattr(lineage, 'enabled', False) else None
+        self.last_seq = None
+        self.last_row_offset = None
 
     @property
     def batched_output(self) -> bool:
         return True
 
     def read_next(self, pool):
-        columns = pool.get_results()
+        columns, seq = unwrap_envelope(pool.get_results(), self._lineage)
+        if seq is not None:
+            self.last_seq = seq
         return self._schema.make_batch_namedtuple(**columns)
 
 
@@ -73,13 +79,21 @@ def _binary_cell_views(column: pa.ChunkedArray) -> list:
 
 
 def _decode_binary_column(column: pa.ChunkedArray, field,
-                          decode_override=None) -> np.ndarray:
+                          decode_override=None,
+                          on_cell_error=None) -> np.ndarray:
     """Decode a codec-encoded binary column into (n, *shape) (fixed shapes)
     or an object array (wildcard shapes, null cells, non-ndarray payloads).
 
     Cells reach the decoder as zero-copy buffer views and the per-cell
     callable comes from ``codec.make_cell_decoder`` (per-column setup hoisted
-    out of the loop) — the two halves of keeping this loop pure decode."""
+    out of the loop) — the two halves of keeping this loop pure decode.
+
+    ``on_cell_error`` (bad-sample quarantine, see
+    :mod:`petastorm_tpu.lineage`): instead of a corrupt cell killing the
+    worker, the column is re-decoded tolerantly — every failing cell is
+    reported as ``on_cell_error(row_offset, exc)`` and decodes to ``None``
+    in an object array; the caller drops those rows and re-densifies. The
+    dense fast path runs first, so clean columns pay nothing."""
     n = len(column)
     fixed = field.shape is not None and all(s is not None for s in field.shape)
     if not n:
@@ -88,7 +102,41 @@ def _decode_binary_column(column: pa.ChunkedArray, field,
         return np.empty(0, dtype=object)
     decode = decode_override or field.codec.make_cell_decoder(field)
     cells = _binary_cell_views(column)
-    if fixed and column.null_count == 0:
+    if on_cell_error is not None:
+        try:
+            return _decode_cells(cells, decode, n, fixed, column.null_count)
+        except NEVER_QUARANTINE:
+            raise   # infrastructure failure, not a bad sample: stay loud
+        except Exception:
+            out = np.empty(n, dtype=object)
+            failed = False
+            for i, cell in enumerate(cells):
+                if cell is None:
+                    out[i] = None
+                    continue
+                try:
+                    out[i] = decode(cell)
+                except NEVER_QUARANTINE:
+                    raise
+                except Exception as e:  # noqa: BLE001 - reported, row dropped
+                    failed = True
+                    on_cell_error(i, e)
+                    out[i] = None
+            if not failed:
+                # every cell decoded cleanly on retry: the dense-path failure
+                # was NOT a per-cell decode error (e.g. a codec returning a
+                # wrong-shaped array breaking dense assignment) — silently
+                # publishing an object column would hide it; re-raise so the
+                # item-level policy sees the real exception
+                raise
+            return out
+    return _decode_cells(cells, decode, n, fixed, column.null_count)
+
+
+def _decode_cells(cells, decode, n: int, fixed: bool,
+                  null_count: int) -> np.ndarray:
+    """The dense/object decode loops shared by the fast and tolerant paths."""
+    if fixed and null_count == 0:
         first = decode(cells[0])
         if isinstance(first, np.ndarray):
             out = np.empty((n,) + first.shape, dtype=first.dtype)
@@ -134,11 +182,15 @@ def _list_column_to_numpy(column: pa.ChunkedArray, field) -> np.ndarray:
 
 
 def _column_to_numpy(column: pa.ChunkedArray, field,
-                     decode_override=None) -> np.ndarray:
-    """Decoded numpy column for any unischema field."""
+                     decode_override=None, on_cell_error=None) -> np.ndarray:
+    """Decoded numpy column for any unischema field. ``on_cell_error``
+    enables tolerant codec decode (see :func:`_decode_binary_column`);
+    vectorized scalar/list conversions cannot isolate cells and fail
+    whole-column under every policy."""
     if field.codec is not None and (
             pa.types.is_binary(column.type) or pa.types.is_large_binary(column.type)):
-        return _decode_binary_column(column, field, decode_override)
+        return _decode_binary_column(column, field, decode_override,
+                                     on_cell_error=on_cell_error)
     if pa.types.is_list(column.type) or pa.types.is_large_list(column.type):
         return _list_column_to_numpy(column, field)
     if pa.types.is_string(column.type) or pa.types.is_large_string(column.type):
@@ -277,46 +329,74 @@ class ColumnarWorker(ParquetPieceWorker):
             if self._transform_spec is not None else None)
 
     def process(self, piece_index: int, worker_predicate=None,
-                shuffle_row_drop_partition=(0, 1)):
+                shuffle_row_drop_partition=(0, 1), epoch=0):
         piece = self._split_pieces[piece_index]
         partition, num_partitions = shuffle_row_drop_partition
-        if (worker_predicate is None and num_partitions == 1
-                and self._transform_spec is not None):
-            # Cache POST-transform (the reference's batch-path semantics:
-            # ``arrow_reader_worker.py:195-227`` applies the TransformSpec
-            # inside the load the cache wraps): epochs 2+ skip BOTH codec
-            # decode and the transform, and a shrinking transform (e.g.
-            # image resize) shrinks the cache payload with it. The key
-            # carries a best-effort transform fingerprint (code bytes +
-            # schema edits) so editing the transform invalidates entries.
-            cache_key = self._cache_key('columnar_tx:' + self._transform_key,
-                                        piece)
-            columns = self._local_cache.get(
-                cache_key, lambda: self._apply_transform(self._load(piece)))
-            if columns and len(next(iter(columns.values()))):
-                self.publish_func(columns)
+        self._begin_item(piece, piece_index, epoch, shuffle_row_drop_partition)
+        try:
+            if (worker_predicate is None and num_partitions == 1
+                    and self._transform_spec is not None):
+                # Cache POST-transform (the reference's batch-path semantics:
+                # ``arrow_reader_worker.py:195-227`` applies the TransformSpec
+                # inside the load the cache wraps): epochs 2+ skip BOTH codec
+                # decode and the transform, and a shrinking transform (e.g.
+                # image resize) shrinks the cache payload with it. The key
+                # carries a best-effort transform fingerprint (code bytes +
+                # schema edits) so editing the transform invalidates entries.
+                cache_key = self._cache_key(
+                    'columnar_tx:' + self._transform_key, piece)
+                columns = self._local_cache.get(
+                    cache_key, lambda: self._apply_transform(self._load(piece)))
+                if columns and len(next(iter(columns.values()))):
+                    n = len(next(iter(columns.values())))
+                    # a transform may change the row count arbitrarily, so
+                    # delivered rows cannot be mapped back to source offsets
+                    self._publish_item(columns, ('opaque', n), n)
+                else:
+                    self._finish_item_empty()
+                return
+            if worker_predicate is not None:
+                columns = self._load_with_predicate(piece, worker_predicate)
+            else:
+                cache_key = self._cache_key('columnar', piece)
+                columns = self._local_cache.get(cache_key,
+                                                lambda: self._load(piece))
+        except Exception as e:  # noqa: BLE001 - policy decides
+            if not self._quarantine_item('decode', e):
+                raise
             return
-        if worker_predicate is not None:
-            columns = self._load_with_predicate(piece, worker_predicate)
-        else:
-            cache_key = self._cache_key('columnar', piece)
-            columns = self._local_cache.get(cache_key, lambda: self._load(piece))
+        offsets = self._last_offsets
         if columns is None:
+            self._finish_item_empty()
             return
         n = len(next(iter(columns.values()))) if columns else 0
         if not n:
+            self._finish_item_empty()
             return
         if num_partitions > 1:
             bounds = np.linspace(0, n, num_partitions + 1, dtype=int)
             lo, hi = bounds[partition], bounds[partition + 1]
             columns = {k: v[lo:hi] for k, v in columns.items()}
+            offsets = self._slice_offsets(offsets, lo, hi)
             if hi <= lo:
+                self._finish_item_empty()
                 return
+            n = int(hi - lo)
         if self._transform_spec is not None:
-            columns = self._apply_transform(columns)
-            if not columns or not len(next(iter(columns.values()))):
+            try:
+                columns = self._apply_transform(columns)
+            except Exception as e:  # noqa: BLE001 - policy decides
+                if not self._quarantine_item('transform', e, rows=n):
+                    raise
                 return
-        self.publish_func(columns)
+            if not columns or not len(next(iter(columns.values()))):
+                self._finish_item_empty()
+                return
+            post_n = len(next(iter(columns.values())))
+            if post_n != n:
+                offsets = None   # count-changing transform: opaque mapping
+            n = post_n
+        self._publish_item(columns, self._compact_selection(offsets, n), n)
 
     # -- loading ---------------------------------------------------------------
 
@@ -333,8 +413,16 @@ class ColumnarWorker(ParquetPieceWorker):
     def _load(self, piece) -> Dict[str, np.ndarray]:
         names = list(self._schema.fields.keys())
         table = self._read_row_group(piece, self._stored_columns(names, piece))
-        columns = self._decode_table(table, names)
-        columns.update(self._partition_columns(piece, table.num_rows, set(names)))
+        sink = self._decode_error_sink()
+        columns = self._decode_table(table, names, error_sink=sink)
+        n = table.num_rows
+        offsets = self._range_offsets(n) if self._tracks_offsets else None
+        if sink is not None and sink.errors:
+            columns, kept = self._apply_quarantine_drops(columns, sink, n)
+            offsets = kept
+            n = len(kept)
+        columns.update(self._partition_columns(piece, n, set(names)))
+        self._last_offsets = offsets
         return columns
 
     def _load_with_predicate(self, piece, predicate) -> Optional[Dict[str, np.ndarray]]:
@@ -361,6 +449,8 @@ class ColumnarWorker(ParquetPieceWorker):
             rest = rest.take(pa.array(idx))
             out.update(self._decode_table(rest, other_stored))
         out.update(self._partition_columns(piece, len(idx), set(other)))
+        self._last_offsets = (idx.astype(np.int64)
+                              if self._tracks_offsets else None)
         return out
 
     # -- transform -------------------------------------------------------------

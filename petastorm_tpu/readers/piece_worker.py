@@ -10,9 +10,13 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
 import pyarrow.parquet as pq
 
 from petastorm_tpu.cache import NullCache
+from petastorm_tpu.lineage import (NEVER_QUARANTINE, LineageEnvelope,
+                                   Provenance, make_quarantine_record,
+                                   validate_decode_error_policy)
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 #: Bound on per-worker open parquet file handles. Many-file datasets used to
@@ -25,6 +29,31 @@ FILE_HANDLE_CACHE_SIZE = 32
 #: treated as remote storage where ``pre_buffer`` (coalesced column-chunk
 #: reads) pays for itself.
 _LOCAL_PROTOCOLS = frozenset({'file', 'local', 'memory'})
+
+#: Cap on the per-record ``row_offsets`` detail of a quarantine record — a
+#: wholesale-corrupt row group must not ship thousands of offsets per item.
+_QUARANTINE_OFFSET_CAP = 64
+
+#: Bound on explicit ``('index', ...)`` selection detail per provenance
+#: record: a predicate matching half of a 500k-row group must not ship (and
+#: ring-retain) one Python int per matching row. Above the cap the selection
+#: degrades to ``('opaque', n)`` — predicate readers are item-exact audited
+#: anyway (``row_filtered``).
+_SELECTION_INDEX_CAP = 4096
+
+
+class DecodeErrorSink:
+    """Per-item collector of cell-level decode failures (tolerant decode
+    path, ``on_decode_error != 'raise'``): ``errors`` holds
+    ``(row_offset, field_name, exception)`` tuples; ``dense_fields`` names
+    columns that fell from the dense fast path to the tolerant object path
+    and must be re-densified after the failing rows are dropped."""
+
+    __slots__ = ('errors', 'dense_fields')
+
+    def __init__(self):
+        self.errors: List[Tuple[int, str, BaseException]] = []
+        self.dense_fields = set()
 
 
 class FileHandleCache:
@@ -112,6 +141,22 @@ class ParquetPieceWorker(WorkerBase):
         # per ventilated piece
         self._dataset_path_digest = hashlib.md5(
             str(self._dataset_path).encode()).hexdigest()
+        # -- lineage / quarantine (see petastorm_tpu/lineage.py) ---------------
+        self._on_decode_error = validate_decode_error_policy(
+            args.get('on_decode_error', 'raise') if isinstance(args, dict)
+            else 'raise')
+        self._shard = args.get('shard', -1) if isinstance(args, dict) else -1
+        # file ordinals by first appearance across the reader's pieces: the
+        # same deterministic table the consumer-side tracker derives
+        self._file_indexes: Dict[str, int] = {}
+        for piece in self._split_pieces:
+            self._file_indexes.setdefault(piece.path, len(self._file_indexes))
+        #: ``(piece, piece_index, epoch, partition)`` of the item being
+        #: processed (workers are single-item-at-a-time by construction).
+        self._item_ctx = None
+        #: Source-row offsets of the last fresh load (``None`` = unknown:
+        #: cache hit, or lineage+quarantine both off so nobody tracks).
+        self._last_offsets: Optional[np.ndarray] = None
         self._decode_hints_digest = ''
         if self._decode_hints:
             self._decode_hints_digest = ':' + hashlib.md5(
@@ -243,10 +288,18 @@ class ParquetPieceWorker(WorkerBase):
                          args={'row_group': piece.row_group})
         return table
 
-    def _decode_table(self, table, names) -> Dict:
+    def _decode_table(self, table, names,
+                      error_sink: Optional[DecodeErrorSink] = None) -> Dict:
         """Arrow table -> decoded numpy columns for ``names`` (full-schema
         typed, honoring per-field decode overrides) — the one columnar decode
-        shared by the columnar worker and the row worker's window path."""
+        shared by the columnar worker and the row worker's window path.
+
+        ``error_sink`` (tolerant decode, ``on_decode_error != 'raise'``)
+        collects per-cell codec failures instead of letting them propagate;
+        the caller drops the failing rows via
+        :meth:`_apply_quarantine_drops`. The dense fast path is tried first
+        and the tolerant re-decode only runs for a column that actually
+        failed, so a clean row group pays nothing for the policy."""
         from petastorm_tpu.readers.columnar_worker import _column_to_numpy
         self.beat('decode')   # entry beat: a wedged codec shows as `decode`
         start = time.perf_counter()
@@ -255,11 +308,176 @@ class ParquetPieceWorker(WorkerBase):
             if name not in table.column_names:
                 continue
             field = self._full_schema.fields[name]
-            out[name] = _column_to_numpy(table.column(name), field,
-                                         self._decode_overrides.get(name))
+            column = table.column(name)
+            on_cell_error = None
+            if error_sink is not None and field.codec is not None:
+                def on_cell_error(row, exc, _name=name):
+                    error_sink.errors.append((row, _name, exc))
+            errors_before = len(error_sink.errors) if error_sink else 0
+            out[name] = _column_to_numpy(column, field,
+                                         self._decode_overrides.get(name),
+                                         on_cell_error=on_cell_error)
+            if (error_sink is not None
+                    and len(error_sink.errors) > errors_before
+                    and field.shape is not None
+                    and all(s is not None for s in field.shape)
+                    and column.null_count == 0):
+                # the fast path would have produced a dense (n, *shape)
+                # array; after the bad rows are dropped, restore that
+                error_sink.dense_fields.add(name)
         self.record_span('decode_columns', 'decode', start,
                          time.perf_counter() - start)
         return out
+
+    # -- lineage / quarantine ----------------------------------------------------
+
+    @property
+    def _tolerant_decode(self) -> bool:
+        """True when decode/transform failures quarantine/skip instead of
+        killing the worker."""
+        return self._on_decode_error != 'raise'
+
+    @property
+    def _tracks_offsets(self) -> bool:
+        return self.lineage_enabled or self._tolerant_decode
+
+    def _begin_item(self, piece, piece_index: int, epoch: int,
+                    partition) -> None:
+        self._item_ctx = (piece, int(piece_index), int(epoch),
+                          tuple(partition or (0, 1)))
+        self._last_offsets = None
+
+    def _make_provenance(self, selection: tuple, rows: int) -> Provenance:
+        piece, piece_index, epoch, partition = self._item_ctx
+        return Provenance(
+            dataset=self._dataset_path_digest[:12],
+            file_index=self._file_indexes.get(piece.path, -1),
+            path=piece.path, row_group=piece.row_group, rows=int(rows),
+            selection=selection, epoch=epoch, shard=self._shard,
+            piece_index=piece_index, partition=partition,
+            worker_id=self.worker_id)
+
+    def _publish_item(self, payload, selection: tuple, rows: int) -> None:
+        """Publish one result, wrapped with its provenance when lineage is
+        on (the pool decides how the envelope crosses its boundary)."""
+        if self.lineage_enabled:
+            self.publish_func(LineageEnvelope(
+                payload, self._make_provenance(selection, rows)))
+        else:
+            self.publish_func(payload)
+
+    def _finish_item_empty(self) -> None:
+        """Record that the current item was processed successfully but has
+        nothing to publish (empty drop-partition slice, no predicate match,
+        empty row group): the provenance rides the accounting channel so the
+        audit sees a zero-row delivery, not a drop."""
+        if self.lineage_enabled:
+            self.record_empty_publish(self._make_provenance(('index', ()), 0))
+
+    @staticmethod
+    def _range_offsets(n: int) -> tuple:
+        """Offsets of a fresh full read, kept SYMBOLIC (``('range', 0, n)``)
+        so the clean hot path never materializes per-row arrays; quarantine
+        drops and predicates produce real index arrays instead."""
+        return ('range', 0, int(n))
+
+    @staticmethod
+    def _slice_offsets(offsets, lo: int, hi: int):
+        """Offsets after a ``[lo:hi)`` payload slice (drop partitions)."""
+        if offsets is None:
+            return None
+        if isinstance(offsets, tuple):
+            base = offsets[1]
+            return ('range', base + int(lo), base + int(hi))
+        return offsets[lo:hi]
+
+    def _compact_selection(self, offsets, rows_n: int) -> tuple:
+        """The most compact selection describing the delivered source rows
+        (``docs/lineage.md`` has the vocabulary). ``offsets`` is a symbolic
+        ``('range', lo, hi)``, an int ndarray, or ``None`` (opaque)."""
+        piece = self._item_ctx[0] if self._item_ctx else None
+        source_rows = getattr(piece, 'num_rows', -1)
+        if offsets is None:
+            return ('opaque', int(rows_n))
+        if isinstance(offsets, tuple):
+            lo, hi = int(offsets[1]), int(offsets[2])
+            if lo == 0 and hi == source_rows:
+                return ('all', hi)
+            return ('slice', lo, hi)
+        n = len(offsets)
+        if n == 0:
+            return ('index', ())
+        contiguous = (n == 1
+                      or (int(offsets[-1]) - int(offsets[0]) == n - 1
+                          and bool(np.all(np.diff(offsets) == 1))))
+        if contiguous:
+            lo, hi = int(offsets[0]), int(offsets[-1]) + 1
+            if lo == 0 and source_rows is not None and hi == source_rows:
+                return ('all', n)
+            return ('slice', lo, hi)
+        if n > _SELECTION_INDEX_CAP:
+            # a huge scattered match set must not ship one Python int per
+            # row through the control frame and the consumer ring
+            return ('opaque', int(rows_n))
+        return ('index', tuple(int(o) for o in offsets))
+
+    def _decode_error_sink(self) -> Optional[DecodeErrorSink]:
+        return DecodeErrorSink() if self._tolerant_decode else None
+
+    def _quarantine_event(self, stage: str, error: BaseException,
+                          rows: int, field: Optional[str] = None,
+                          row_offsets=None) -> None:
+        """Count one quarantine/skip event; record it when the policy is
+        ``'quarantine'`` (``'skip'`` drops silently but still counts)."""
+        self.record_count('rows_quarantined', int(rows))
+        self.record_count('items_quarantined', 1)
+        if self._on_decode_error != 'quarantine':
+            return
+        piece, piece_index, epoch, partition = self._item_ctx
+        self.record_quarantine(make_quarantine_record(
+            piece, piece_index, epoch, partition, self._shard, stage, error,
+            field=field, rows=rows,
+            row_offsets=(list(row_offsets)[:_QUARANTINE_OFFSET_CAP]
+                         if row_offsets is not None else None)))
+
+    def _quarantine_item(self, stage: str, error: BaseException,
+                         rows: Optional[int] = None) -> bool:
+        """Quarantine/skip a whole failing item; returns False when the
+        error must propagate (policy ``'raise'``, or an infrastructure
+        exception that no policy may swallow)."""
+        if not self._tolerant_decode or isinstance(error, NEVER_QUARANTINE):
+            return False
+        piece = self._item_ctx[0]
+        if rows is None:
+            rows = piece.num_rows if (piece.num_rows or 0) >= 0 else 1
+        self._quarantine_event(stage, error, rows)
+        return True
+
+    def _apply_quarantine_drops(self, columns: Dict[str, np.ndarray],
+                                sink: DecodeErrorSink,
+                                num_rows: int) -> Tuple[Dict, np.ndarray]:
+        """Drop the rows that failed cell-level decode from every column
+        (re-densifying columns the tolerant path demoted to object arrays),
+        record the quarantine events, and return ``(columns,
+        kept_offsets)``."""
+        bad_rows = sorted({row for row, _field, _exc in sink.errors})
+        by_field: Dict[str, List] = {}
+        for row, field, exc in sink.errors:
+            by_field.setdefault(field, []).append((row, exc))
+        for field, fails in by_field.items():
+            self._quarantine_event('decode', fails[0][1], rows=len(fails),
+                                   field=field,
+                                   row_offsets=[r for r, _e in fails])
+        keep = np.ones(num_rows, dtype=bool)
+        keep[np.asarray(bad_rows, dtype=np.int64)] = False
+        kept = np.flatnonzero(keep)
+        out = {}
+        for name, arr in columns.items():
+            arr = arr[kept] if len(arr) == num_rows else arr
+            if name in sink.dense_fields and arr.dtype == object and len(arr):
+                arr = np.stack(list(arr))
+            out[name] = arr
+        return out, kept
 
     def _cache_key(self, prefix: str, piece) -> str:
         # decode_hints change what a decoded row group contains (e.g. image

@@ -16,6 +16,7 @@ from typing import List
 
 import numpy as np
 
+from petastorm_tpu.lineage import unwrap_envelope
 from petastorm_tpu.ngram import NGramWindowChunk
 from petastorm_tpu.readers.piece_worker import ParquetPieceWorker
 from petastorm_tpu.unischema import decode_row
@@ -30,7 +31,7 @@ class RowGroupResultsReader:
     """Consumer-side: buffers published row lists and pops one row at a time as
     schema namedtuples (reference ``PyDictReaderWorkerResultsQueueReader``)."""
 
-    def __init__(self, schema, ngram):
+    def __init__(self, schema, ngram, lineage=None):
         self._schema = schema
         self._ngram = ngram
         self._buffer: List = []
@@ -42,6 +43,15 @@ class RowGroupResultsReader:
         # threads can both see an empty buffer, both fetch a chunk, and one
         # assignment silently overwrites the other's unconsumed rows.
         self._lock = threading.Lock()
+        #: The reader's :class:`~petastorm_tpu.lineage.LineageTracker`;
+        #: provenance envelopes unwrap (and register) here, and
+        #: ``last_seq``/``last_row_offset`` name the source of the most
+        #: recently popped row (single-consumer contract: with concurrent
+        #: consumer threads the attribution is per-thread approximate).
+        self._lineage = lineage if getattr(lineage, 'enabled', False) else None
+        self._buffer_seq = None
+        self.last_seq = None
+        self.last_row_offset = None
 
     @property
     def batched_output(self) -> bool:
@@ -60,13 +70,18 @@ class RowGroupResultsReader:
         with self._lock:
             while not self._buffer:
                 # raises EmptyResultError at end of stream; propagates to Reader
-                item = pool.get_results()
+                item, seq = unwrap_envelope(pool.get_results(), self._lineage)
+                self._buffer_seq = seq
                 if isinstance(item, NGramWindowChunk):
                     self._buffer = [self._chunk_window_dict(item, i)
                                     for i in range(len(item))]
                 else:
                     self._buffer = list(item)
             item = self._buffer.pop()
+            # pop() takes the payload's tail: after it, len(buffer) IS the
+            # popped row's offset within the published payload
+            self.last_seq = self._buffer_seq
+            self.last_row_offset = len(self._buffer)
         if self._ngram:
             # workers ship windows as plain dicts (namedtuple classes of
             # schema views cannot cross the process-pool pickle boundary);
@@ -86,7 +101,11 @@ class RowGroupResultsReader:
         valid on a reader whose workers publish chunks
         (``Reader.ngram_chunked``) and must not be mixed with per-window
         ``read_next`` calls on a buffered item."""
-        return pool.get_results()
+        chunk, seq = unwrap_envelope(pool.get_results(), self._lineage)
+        if seq is not None:
+            self.last_seq = seq
+            self.last_row_offset = None
+        return chunk
 
 
 class RowGroupWorker(ParquetPieceWorker):
@@ -98,8 +117,9 @@ class RowGroupWorker(ParquetPieceWorker):
         self._ngram = args['ngram']
 
     def process(self, piece_index: int, worker_predicate=None,
-                shuffle_row_drop_partition=(0, 1)):
+                shuffle_row_drop_partition=(0, 1), epoch=0):
         piece = self._split_pieces[piece_index]
+        self._begin_item(piece, piece_index, epoch, shuffle_row_drop_partition)
         if (self._ngram is not None and worker_predicate is None
                 and self._transform_spec is None):
             # Columnar window path: decode the group column-wise (vectorized
@@ -109,28 +129,62 @@ class RowGroupWorker(ParquetPieceWorker):
             # worker GIL time to run 3.4x slower than its indexed twin on the
             # identical workload (BENCH_r04). Predicate/transform items keep
             # the row path: both contracts are per-row here.
-            chunk = self._form_window_chunk(piece, shuffle_row_drop_partition)
+            try:
+                chunk = self._form_window_chunk(piece,
+                                                shuffle_row_drop_partition)
+            except Exception as e:  # noqa: BLE001 - policy decides
+                if not self._quarantine_item('decode', e):
+                    raise
+                return
             if chunk is not None:
-                self.publish_func(chunk)
+                self._publish_item(chunk, ('windows', len(chunk)), len(chunk))
+            else:
+                self._finish_item_empty()
             return
-        if worker_predicate is not None:
-            rows = self._load_rows_with_predicate(piece, worker_predicate)
-        else:
-            cache_key = self._cache_key('rowgroup', piece)
-            rows = self._local_cache.get(cache_key, lambda: self._load_rows(piece))
-        rows = self._drop_partition(rows, piece, *shuffle_row_drop_partition)
+        try:
+            if worker_predicate is not None:
+                rows = self._load_rows_with_predicate(piece, worker_predicate)
+            else:
+                cache_key = self._cache_key('rowgroup', piece)
+                rows = self._local_cache.get(cache_key,
+                                             lambda: self._load_rows(piece))
+        except Exception as e:  # noqa: BLE001 - policy decides
+            if not self._quarantine_item('decode', e):
+                raise
+            return
+        offsets = self._last_offsets
+        rows, offsets = self._drop_partition(rows, piece,
+                                             *shuffle_row_drop_partition,
+                                             offsets=offsets)
         if self._transform_spec is not None:
-            rows = [self._apply_transform(r) for r in rows]
+            rows, offsets = self._transform_rows(rows, offsets)
         if self._ngram is not None:
             rows = self._ngram.form_ngram_dicts(rows, self._transformed_schema)
+            if rows:
+                # windows, not rows: window k spans several source rows
+                self._publish_item(rows, ('windows', len(rows)), len(rows))
+            else:
+                self._finish_item_empty()
+            return
         if rows:
-            self.publish_func(rows)
+            self._publish_item(rows,
+                               self._compact_selection(offsets, len(rows)),
+                               len(rows))
+        else:
+            self._finish_item_empty()
 
     # -- columnar window path --------------------------------------------------
 
-    def _load_columns(self, piece, names, preserve_scalar_nulls=False):
+    def _load_columns(self, piece, names, preserve_scalar_nulls=False,
+                      tolerant=False):
         """Read + columnar-decode ``names`` (partition columns synthesized) —
         shared by the window-chunk path and the columnar row load.
+
+        ``tolerant``: collect cell-level codec failures and drop the failing
+        rows (quarantine; sets ``self._last_offsets`` to the kept source
+        offsets). The window-chunk path keeps it off — dropping rows from a
+        window universe would silently shift every window after the hole, so
+        NGram corruption quarantines at item granularity instead.
 
         ``preserve_scalar_nulls``: the ROW path's contract is decode_row's —
         a null cell is ``None``, never a NaN-holed float that an astype to
@@ -143,7 +197,8 @@ class RowGroupWorker(ParquetPieceWorker):
         NaN-holing arrow/pandas parity."""
         from petastorm_tpu.readers.columnar_worker import make_partition_columns
         table = self._read_columns(piece, self._stored_columns(names, piece))
-        columns = self._decode_table(table, names)
+        sink = self._decode_error_sink() if tolerant else None
+        columns = self._decode_table(table, names, error_sink=sink)
         if preserve_scalar_nulls:
             for name in names:
                 if name not in table.column_names or name not in columns:
@@ -163,8 +218,15 @@ class RowGroupWorker(ParquetPieceWorker):
                           else (decode(v) if decode is not None else v)
                           for v in column.to_pylist()]
                 columns[name] = out
+        n = table.num_rows
+        offsets = self._range_offsets(n) if self._tracks_offsets else None
+        if sink is not None and sink.errors:
+            columns, kept = self._apply_quarantine_drops(columns, sink, n)
+            offsets = kept
+            n = len(kept)
         columns.update(make_partition_columns(self._full_schema, piece,
-                                              table.num_rows, set(names)))
+                                              n, set(names)))
+        self._last_offsets = offsets
         return columns
 
     def _load_window_columns(self, piece):
@@ -235,14 +297,18 @@ class RowGroupWorker(ParquetPieceWorker):
                            if n in self._schema.fields or n in self._full_schema.fields]
             table = self._read_columns(piece,
                                        self._stored_columns(field_names, piece))
-            return self._decode_with_partitions(table.to_pylist(), piece,
+            rows = self._decode_with_partitions(table.to_pylist(), piece,
                                                 self._full_schema)
+            self._last_offsets = (self._range_offsets(len(rows))
+                                  if self._tracks_offsets else None)
+            return rows
         # Row path decodes COLUMN-wise (shared _decode_table: hoisted cell
         # decoders, zero-copy cell views, vectorized scalar/list conversion)
         # and then splits into row dicts — ~2x less non-codec overhead per
         # row than to_pylist + per-row decode_row on decode-bound stores.
         names = list(self._schema.fields.keys())
-        columns = self._load_columns(piece, names, preserve_scalar_nulls=True)
+        columns = self._load_columns(piece, names, preserve_scalar_nulls=True,
+                                     tolerant=self._tolerant_decode)
         keys = [n for n in names if n in columns]
         cols = [columns[k] for k in keys]
         return [dict(zip(keys, values)) for values in zip(*cols)]
@@ -260,6 +326,8 @@ class RowGroupWorker(ParquetPieceWorker):
             predicate_table.to_pylist(), piece, self._full_schema)
         match_indices = [i for i, row in enumerate(predicate_rows)
                          if predicate.do_include({f: row[f] for f in predicate_fields})]
+        self._last_offsets = (np.asarray(match_indices, dtype=np.int64)
+                              if self._tracks_offsets else None)
         if not match_indices:
             return []
         other_fields = [n for n in self._schema.fields.keys() if n not in predicate_fields]
@@ -280,17 +348,49 @@ class RowGroupWorker(ParquetPieceWorker):
 
     # -- post-processing -------------------------------------------------------
 
-    def _drop_partition(self, rows: List[dict], piece, partition: int, num_partitions: int):
+    def _drop_partition(self, rows: List[dict], piece, partition: int,
+                        num_partitions: int, offsets=None):
         """Deterministically keep 1/num_partitions of the row group; with ngram,
         extend by length-1 continuation rows so windows spanning the boundary
-        survive (reference ``py_dict_reader_worker.py:260-273``)."""
+        survive (reference ``py_dict_reader_worker.py:260-273``). Returns
+        ``(rows, offsets)`` with the provenance offsets sliced in lockstep."""
         if num_partitions <= 1:
-            return rows
+            return rows, offsets
         bounds = np.linspace(0, len(rows), num_partitions + 1, dtype=int)
         start, stop = bounds[partition], bounds[partition + 1]
         if self._ngram is not None:
             stop = min(stop + self._ngram.length - 1, len(rows))
-        return rows[start:stop]
+        offsets = self._slice_offsets(offsets, start, stop)
+        return rows[start:stop], offsets
+
+    def _transform_rows(self, rows: List[dict], offsets):
+        """Apply the TransformSpec per row; under quarantine/skip policies a
+        row whose transform raises is dropped (and recorded with its exact
+        source offset) instead of killing the worker."""
+        if not self._tolerant_decode:
+            return [self._apply_transform(r) for r in rows], offsets
+        out, kept = [], []
+        range_base = offsets[1] if isinstance(offsets, tuple) else None
+        for i, row in enumerate(rows):
+            try:
+                out.append(self._apply_transform(row))
+                kept.append(i)
+            except Exception as e:  # noqa: BLE001 - policy decides
+                if offsets is None:
+                    off = None
+                elif range_base is not None:
+                    off = range_base + i
+                else:
+                    off = int(offsets[i])
+                self._quarantine_event(
+                    'transform', e, rows=1,
+                    row_offsets=None if off is None else [off])
+        if offsets is not None and len(kept) != len(rows):
+            if isinstance(offsets, tuple):
+                offsets = np.arange(offsets[1], offsets[2], dtype=np.int64)
+            offsets = (offsets[np.asarray(kept, dtype=np.int64)]
+                       if kept else offsets[:0])
+        return out, offsets
 
     def _apply_transform(self, row: dict) -> dict:
         spec = self._transform_spec

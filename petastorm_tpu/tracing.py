@@ -43,6 +43,7 @@ without touching the training loop.
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
@@ -193,10 +194,16 @@ class Tracer:
     def export_chrome_trace(self, path: str) -> int:
         """Write the buffered spans as Chrome trace-event JSON (open the file
         in Perfetto / ``chrome://tracing``). Returns the number of span
-        events written."""
+        events written.
+
+        The write is atomic (tmp file + ``os.replace``): a crash mid-dump —
+        exactly when traces matter most — must never leave a truncated JSON
+        that Perfetto rejects, and a previous good export at the same path
+        survives a failed rewrite."""
+        from petastorm_tpu.utils import atomic_write
         events = self.chrome_trace_events()
-        with open(path, 'w') as f:
-            json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+        atomic_write(path, lambda f: json.dump(
+            {'traceEvents': events, 'displayTimeUnit': 'ms'}, f))
         return sum(1 for e in events if e['ph'] == 'X')
 
     def tail(self, limit: int = 500) -> List[dict]:
@@ -214,20 +221,37 @@ class Tracer:
                 for name, cat, start_s, dur_s, pid, tid, args in spans]
 
 
+def _prometheus_value(value: float) -> str:
+    """One sample value per the text-exposition format: finite floats print
+    normally, non-finite ones as the spec's ``NaN``/``+Inf``/``-Inf``
+    literals (``float()`` would print ``nan``/``inf``, which scrape parsers
+    reject — derived ratios can legitimately be non-finite)."""
+    value = float(value)
+    if math.isnan(value):
+        return 'NaN'
+    if math.isinf(value):
+        return '+Inf' if value > 0 else '-Inf'
+    return repr(value)
+
+
 def prometheus_text(snapshot: dict, prefix: str = 'petastorm_tpu') -> str:
     """A stats snapshot in Prometheus text-exposition format — the one
     formatter shared by :class:`MetricsEmitter` (``.prom`` textfile
     collector output) and the debug endpoint's ``/metrics`` route.
     Non-numeric values are skipped; everything is exposed as a gauge (the
-    snapshot is a point-in-time scrape, not a counter stream)."""
+    snapshot is a point-in-time scrape, not a counter stream) with a
+    ``# HELP`` line, and non-finite values use the spec's
+    ``NaN``/``+Inf``/``-Inf`` literals."""
     lines = []
     for key in sorted(snapshot):
         value = snapshot[key]
         if not isinstance(value, (int, float)) or isinstance(value, bool):
             continue
         metric = '{}_{}'.format(prefix, key)
+        lines.append('# HELP {} petastorm_tpu reader stat {!r} '
+                     '(see docs/transport.md key table)'.format(metric, key))
         lines.append('# TYPE {} gauge'.format(metric))
-        lines.append('{} {}'.format(metric, float(value)))
+        lines.append('{} {}'.format(metric, _prometheus_value(value)))
     return '\n'.join(lines) + '\n'
 
 
@@ -293,10 +317,10 @@ class MetricsEmitter:
             self.emit_count += 1
 
     def _write_prometheus(self, snapshot: dict) -> None:
-        tmp = '{}.tmp.{}'.format(self._path, os.getpid())
-        with open(tmp, 'w') as f:
-            f.write(prometheus_text(snapshot, self._prefix))
-        os.replace(tmp, self._path)
+        from petastorm_tpu.utils import atomic_write
+        atomic_write(self._path,
+                     lambda f: f.write(prometheus_text(snapshot,
+                                                       self._prefix)))
 
     def stop(self, join: bool = True) -> None:
         """Signal the thread to stop; with ``join`` (the default) also wait

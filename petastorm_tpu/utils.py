@@ -6,9 +6,29 @@ Reference analogue: ``petastorm/utils.py`` (its ``decode_row`` lives on
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 _FALSY_STRINGS = frozenset(('false', '0', '', 'no'))
+
+
+def atomic_write(path: str, write_fn) -> str:
+    """Write a text artifact atomically: ``write_fn(file)`` runs against a
+    sibling tmp file that is ``os.replace``d over ``path`` only on success,
+    and never outlives a failed write. A crash mid-dump — exactly when
+    diagnostic artifacts (chrome traces, flight records, ``.prom`` files)
+    matter most — can neither leave truncated output that tooling rejects
+    nor clobber a previous good artifact at the same path."""
+    tmp = '{}.tmp.{}'.format(path, os.getpid())
+    try:
+        with open(tmp, 'w') as f:
+            write_fn(f)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
 
 
 def parse_bool_string(value: str) -> bool:

@@ -24,6 +24,9 @@ class DummyPool:
         #: Optional :class:`petastorm_tpu.tracing.Tracer`; spans record on
         #: the caller thread (work executes lazily inside ``get_results``).
         self.tracer = tracer
+        #: Optional :class:`petastorm_tpu.lineage.LineageTracker` (set by the
+        #: Reader before :meth:`start`) receiving quarantine records.
+        self.lineage = None
 
     @property
     def workers_count(self) -> int:
@@ -61,6 +64,14 @@ class DummyPool:
                     counts, gauges = self._worker.drain_stat_counts()
                     self.stats.merge_counts(counts)
                     self.stats.merge_gauges(gauges)
+                if hasattr(self._worker, 'drain_quarantines'):
+                    quarantines = self._worker.drain_quarantines()
+                    if quarantines and self.lineage is not None:
+                        self.lineage.add_quarantines(quarantines)
+                if hasattr(self._worker, 'drain_empty_publishes'):
+                    for prov in self._worker.drain_empty_publishes():
+                        if self.lineage is not None:
+                            self.lineage.register(prov)
                 if self.tracer is not None:
                     self.tracer.add_span('process_item', 'worker', start,
                                          elapsed)

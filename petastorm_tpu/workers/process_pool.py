@@ -32,6 +32,7 @@ import time
 import traceback
 from typing import Optional
 
+from petastorm_tpu.lineage import LineageEnvelope
 from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
                                    VentilatedItemProcessedMessage)
 from petastorm_tpu.workers.exec_in_new_process import exec_in_new_process
@@ -105,6 +106,12 @@ class ProcessPool:
         #: accounting message (same pattern as the stage times); the pool
         #: merges them here with their original (pid, tid) tracks.
         self.tracer = tracer
+        #: Optional :class:`petastorm_tpu.lineage.LineageTracker` (set by the
+        #: Reader before :meth:`start`). Quarantine records arrive in the
+        #: accounting message; per-item provenance rides the ``DATA`` control
+        #: frame (payload frames stay zero-copy) and is re-wrapped into a
+        #: :class:`~petastorm_tpu.lineage.LineageEnvelope` on this side.
+        self.lineage = None
         self._processes = []
         self._ventilator = None
         self._context = None
@@ -256,6 +263,10 @@ class ProcessPool:
             if isinstance(control, _WorkerHeartbeat):
                 self._merge_heartbeats(control.records)
                 continue
+            provenance = None
+            if isinstance(control, tuple) and len(control) == 2 \
+                    and control[0] == _DATA:
+                control, provenance = control
             if control == _DATA:
                 with self._accounting_lock:
                     self._results_produced += 1
@@ -279,6 +290,8 @@ class ProcessPool:
                                sum(_nbytes(f) for f in payload_frames))
                 self.stats.add('payload_frames', len(payload_frames))
                 self.stats.add('items_out')
+                if provenance is not None:
+                    result = LineageEnvelope(result, provenance)
                 return result
             # _WorkerStarted duplicates / stray messages are ignored.
 
@@ -314,6 +327,11 @@ class ProcessPool:
         self.stats.merge_counts(item_stats.get('counts'))
         self.stats.merge_gauges(item_stats.get('gauges'))
         self._merge_heartbeats(item_stats.get('heartbeats'))
+        if self.lineage is not None and item_stats.get('quarantines'):
+            self.lineage.add_quarantines(item_stats['quarantines'])
+        if self.lineage is not None:
+            for prov in item_stats.get('empty_publishes', ()):
+                self.lineage.register(prov)
         if self.tracer is not None:
             self.tracer.merge(item_stats.get('spans'))
         for counter in ('payload_copies',):
@@ -446,6 +464,13 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
         item['publish_wait_s'] += time.perf_counter() - start
 
     def publish(data):
+        # Lineage envelopes are unwrapped HERE: the provenance record rides
+        # in the pickled control frame next to the DATA marker, so the
+        # payload serializer (and its zero-copy frames) never sees it.
+        provenance = None
+        if isinstance(data, LineageEnvelope):
+            provenance = data.provenance
+            data = data.payload
         start = time.perf_counter()
         frames = serializer.serialize_multipart(data)
         serialized = time.perf_counter()
@@ -454,7 +479,7 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
             item_spans.append(('serialize', 'transport', start,
                                serialized - start, trace_pid,
                                threading.get_ident(), None))
-        send(frames, _DATA)
+        send(frames, _DATA if provenance is None else (_DATA, provenance))
 
     try:
         worker = worker_class(worker_id, publish, worker_args)
@@ -574,6 +599,14 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
                     item_stats['counts'] = counts
                 if gauges:
                     item_stats['gauges'] = gauges
+            if hasattr(worker, 'drain_quarantines'):
+                quarantines = worker.drain_quarantines()
+                if quarantines:
+                    item_stats['quarantines'] = quarantines
+            if hasattr(worker, 'drain_empty_publishes'):
+                empty = worker.drain_empty_publishes()
+                if empty:
+                    item_stats['empty_publishes'] = empty
             if hasattr(worker, 'item_done'):
                 worker.item_done()
             if health_on and hb_snapshot is not None:

@@ -46,6 +46,8 @@ COUNTERS = (
     'items_out',         # results delivered to the consumer
     'readahead_hits',    # row-group reads served from the prefetch queue
     'readahead_misses',  # row-group reads that went inline (not prefetched)
+    'rows_quarantined',  # rows dropped under on_decode_error='skip'/'quarantine'
+    'items_quarantined',  # quarantine/skip events (items or row batches)
 )
 
 #: Occupancy gauges; each also keeps a ``<name>_max`` high-water mark.

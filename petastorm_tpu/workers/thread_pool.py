@@ -115,6 +115,14 @@ class WorkerThread(threading.Thread):
                     counts, gauges = self._worker.drain_stat_counts()
                     stats.merge_counts(counts)
                     stats.merge_gauges(gauges)
+                if hasattr(self._worker, 'drain_quarantines'):
+                    quarantines = self._worker.drain_quarantines()
+                    if quarantines and self._pool.lineage is not None:
+                        self._pool.lineage.add_quarantines(quarantines)
+                if hasattr(self._worker, 'drain_empty_publishes'):
+                    for prov in self._worker.drain_empty_publishes():
+                        if self._pool.lineage is not None:
+                            self._pool.lineage.register(prov)
                 tracer = self._pool.tracer
                 if tracer is not None:
                     tracer.add_span('process_item', 'worker', start, elapsed)
@@ -148,6 +156,10 @@ class ThreadPool:
         #: Optional :class:`petastorm_tpu.tracing.Tracer`; worker threads
         #: record process/io/decode spans into it directly.
         self.tracer = tracer
+        #: Optional :class:`petastorm_tpu.lineage.LineageTracker` (set by the
+        #: Reader before :meth:`start`); worker quarantine records drain
+        #: straight into it.
+        self.lineage = None
         self._profiles = []
         self._profiles_lock = threading.Lock()
         self._stop_event = threading.Event()

@@ -148,6 +148,11 @@ class ConcurrentVentilator(BackPressuredVentilator):
     :param heartbeat: optional ``heartbeat(entity, stage)`` callable; the
         ventilator thread publishes liveness as entity ``'ventilator'``
         (see :mod:`petastorm_tpu.health`).
+    :param epoch_key: when set, each dict item is ventilated with an extra
+        ``{epoch_key: current_epoch}`` kwarg so workers can stamp results
+        with the epoch they belong to (the provenance layer's epoch source,
+        see :mod:`petastorm_tpu.lineage`). Epoch numbers are globally
+        monotone: :meth:`reset` continues counting, it never rewinds.
     """
 
     def __init__(self, ventilate_fn, items: List, iterations: Optional[int] = 1,
@@ -156,7 +161,8 @@ class ConcurrentVentilator(BackPressuredVentilator):
                  max_ventilation_queue_size: Optional[int] = None,
                  ventilation_interval_s: float = 0.01,
                  start_epoch: int = 0,
-                 heartbeat=None):
+                 heartbeat=None,
+                 epoch_key: Optional[str] = None):
         if iterations is not None and iterations < 1:
             raise ValueError('iterations must be positive or None, got {}'.format(iterations))
         items = list(items)
@@ -170,6 +176,7 @@ class ConcurrentVentilator(BackPressuredVentilator):
         self._rng = np.random.default_rng(random_seed)
         self._random_seed = random_seed
         self._epoch = start_epoch
+        self._epoch_key = epoch_key
         if not self._items:
             self._completed.set()
 
@@ -191,7 +198,12 @@ class ConcurrentVentilator(BackPressuredVentilator):
             for item in order:
                 if not self._acquire_slot():
                     return
-                self._ventilate_fn(**item) if isinstance(item, dict) else self._ventilate_fn(item)
+                if isinstance(item, dict):
+                    if self._epoch_key is not None:
+                        item = dict(item, **{self._epoch_key: self._epoch})
+                    self._ventilate_fn(**item)
+                else:
+                    self._ventilate_fn(item)
             self._epoch += 1
             if self._iterations_remaining is not None:
                 self._iterations_remaining -= 1

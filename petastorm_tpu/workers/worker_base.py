@@ -41,6 +41,14 @@ class WorkerBase(ABC):
         self.heartbeats = {}
         self.health_enabled = not (isinstance(args, dict)
                                    and args.get('health') is False)
+        #: Sample-lineage publication gate (see
+        #: :mod:`petastorm_tpu.lineage`): when set, piece workers wrap each
+        #: published payload in a provenance envelope and quarantine records
+        #: accumulate here until the owning pool drains them (accounting
+        #: message for process pools, direct merge for in-process pools).
+        self.lineage_enabled = isinstance(args, dict) and bool(args.get('lineage'))
+        self.quarantine_records = []
+        self.empty_publishes = []
         self._entity = 'worker-{}'.format(worker_id)
         self._items_done = 0
         if self.health_enabled:
@@ -107,6 +115,29 @@ class WorkerBase(ABC):
         counts, self.stat_counts = self.stat_counts, {}
         gauges, self.stat_gauges = self.stat_gauges, {}
         return counts, gauges
+
+    def record_quarantine(self, record: dict) -> None:
+        """Accumulate one bad-sample quarantine record (see
+        :func:`petastorm_tpu.lineage.make_quarantine_record`); drained like
+        the stats after each processed item."""
+        self.quarantine_records.append(record)
+
+    def drain_quarantines(self) -> list:
+        """Return and reset the accumulated quarantine records."""
+        records, self.quarantine_records = self.quarantine_records, []
+        return records
+
+    def record_empty_publish(self, provenance) -> None:
+        """Accumulate the provenance of an item that was processed fine but
+        legitimately produced ZERO results (empty drop-partition slice,
+        predicate matching nothing, empty row group). No payload crosses the
+        pool, so the record travels the accounting channel instead — without
+        it the coverage audit would misread the item as a silent drop."""
+        self.empty_publishes.append(provenance)
+
+    def drain_empty_publishes(self) -> list:
+        records, self.empty_publishes = self.empty_publishes, []
+        return records
 
     def record_span(self, name: str, cat: str, start_s: float, dur_s: float,
                     args=None) -> None:

@@ -90,7 +90,9 @@ class TestHostLoader:
                 reader, batch_size=8,
                 transform_fn=lambda b: {'twice': b['id'] * 2})
             batch = next(iter(loader))
-        assert set(batch.keys()) == {'twice'}
+        # '_provenance' is the loader's reserved lineage annotation (see
+        # docs/lineage.md); the transform itself only ever sees its own keys
+        assert set(batch.keys()) - {'_provenance'} == {'twice'}
 
     def test_inmemory_cache_replays_epochs(self, scalar_dataset):
         with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',

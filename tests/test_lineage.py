@@ -1,0 +1,496 @@
+"""Sample-lineage tests: provenance threading across the three pools, epoch
+coverage auditing (sharded runs, worker death, reset), shuffle-quality
+metrics, bad-sample quarantine under all three ``on_decode_error`` policies,
+bit-exact replay, the ``/coverage`` endpoint, flight-record lineage, and the
+``PETASTORM_TPU_LINEAGE=0`` kill switch."""
+
+import collections
+import json
+import os
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.jax_utils import JaxDataLoader
+from petastorm_tpu.lineage import (LINEAGE_COLUMN, PROVENANCE_KEY,
+                                   BatchProvenance, CoverageAuditor,
+                                   LineageTracker, Provenance,
+                                   lineage_enabled, pack_rows, pack_source,
+                                   unpack_source)
+from petastorm_tpu.reader import (make_batch_reader, make_columnar_reader,
+                                  make_reader)
+from petastorm_tpu.test_util.dataset_gen import (create_non_petastorm_dataset,
+                                                 create_test_dataset)
+from petastorm_tpu.transform import TransformSpec
+
+
+def _http_get(port, route):
+    from http.client import HTTPConnection
+    conn = HTTPConnection('127.0.0.1', port, timeout=10)
+    try:
+        conn.request('GET', route)
+        response = conn.getresponse()
+        return response.status, response.read().decode('utf-8')
+    finally:
+        conn.close()
+
+
+@pytest.fixture()
+def corrupt_dataset(tmp_path):
+    """TestSchema store where ONE encoded 'matrix' cell is garbage bytes —
+    the exact "a single corrupt sample kills the reader" scenario. The
+    rewrite preserves the 1-row row-group layout so the petastorm metadata
+    stays truthful."""
+    url = 'file://' + str(tmp_path / 'corrupt')
+    create_test_dataset(url, range(24), num_files=2)
+    path = str(tmp_path / 'corrupt')
+    files = sorted(os.path.join(path, f) for f in os.listdir(path)
+                   if f.endswith('.parquet'))
+    table = pq.read_table(files[0])
+    cells = table.column('matrix').to_pylist()
+    poison_row = 2
+    cells[poison_row] = b'garbage-not-an-encoded-ndarray'
+    idx = table.column_names.index('matrix')
+    table = table.set_column(idx, 'matrix', pa.array(
+        cells, type=table.schema.field('matrix').type))
+    pq.write_table(table, files[0], row_group_size=1)
+    return url
+
+
+# -- packing / unit pieces ----------------------------------------------------
+
+class TestPacking:
+    def test_pack_roundtrip(self):
+        packed = pack_source(1234, 567)
+        assert unpack_source(packed) == (1234, 567)
+
+    def test_pack_rows_vectorized(self):
+        arr = pack_rows(7, 4)
+        assert arr.dtype == np.int64
+        assert [unpack_source(p) for p in arr] == [(7, i) for i in range(4)]
+
+    def test_batch_provenance_shuffle_quality(self):
+        sources = np.asarray([pack_source(s, i) for s, i in
+                              [(1, 0), (1, 1), (2, 0), (1, 2), (2, 1)]])
+        bp = BatchProvenance(sources, None)
+        quality = bp.shuffle_quality()
+        assert quality['rows'] == 5
+        assert quality['sources'] == 2
+        assert quality['adjacent_source_runs'] == 4
+        assert quality['run_length_max'] == 2
+
+    def test_tracker_ring_bounds(self):
+        tracker = LineageTracker(enabled=True, record_capacity=4)
+        record = Provenance('d', 0, '/p', 0, 1, ('all', 1), 0, -1, 0,
+                            (0, 1), 0)
+        seqs = [tracker.register(record) for _ in range(10)]
+        assert tracker.resolve(seqs[0]) is None      # evicted
+        assert tracker.resolve(seqs[-1]) is not None
+        assert tracker.records_registered == 10
+
+    def test_epoch_ledger_eviction(self):
+        tracker = LineageTracker(enabled=True, epoch_capacity=2)
+        for epoch in range(5):
+            tracker.record_ventilated(epoch, 0, (0, 1))
+        assert tracker.epochs() == [3, 4]
+
+
+# -- provenance threading -----------------------------------------------------
+
+class TestProvenanceThreading:
+    @pytest.mark.parametrize('pool', ['thread', 'process', 'dummy'])
+    def test_row_reader_provenance_all_pools(self, synthetic_dataset, pool):
+        with make_reader(synthetic_dataset.url, reader_pool_type=pool,
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            rows = sum(1 for _ in reader)
+            assert rows == len(synthetic_dataset.data)
+            record = reader.last_provenance
+            assert isinstance(record, Provenance)
+            assert record.path.endswith('.parquet')
+            assert record.selection[0] in ('all', 'slice', 'index')
+            assert record.epoch == 0
+            report = reader.audit().assert_complete()
+            assert report['epochs'][0]['items_delivered'] > 0
+            assert report['epochs'][0]['row_exact']
+
+    def test_batch_reader_provenance(self, non_petastorm_dataset):
+        with make_batch_reader(non_petastorm_dataset.url,
+                               reader_pool_type='thread', workers_count=2,
+                               num_epochs=1) as reader:
+            total = sum(len(batch.id) for batch in reader)
+            assert total == len(non_petastorm_dataset.data)
+            # batched output: the last yielded batch IS one row group
+            explained = reader.explain_batch()
+            assert explained['enabled']
+            assert explained['sources'][0]['row_group'] >= 0
+            reader.audit().assert_complete()
+
+    def test_columnar_reader_provenance(self, synthetic_dataset):
+        with make_columnar_reader(synthetic_dataset.url,
+                                  reader_pool_type='thread', workers_count=2,
+                                  num_epochs=1) as reader:
+            for _ in reader:
+                pass
+            assert reader.last_provenance is not None
+            reader.audit().assert_complete()
+
+    def test_drop_partitions_audit_row_exact(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_drop_partitions=2) as reader:
+            rows = sum(1 for _ in reader)
+            assert rows == len(synthetic_dataset.data)
+            report = reader.audit().assert_complete()
+            verdict = report['epochs'][0]
+            # every row group was split into 2 slice-selections whose union
+            # must cover it exactly once
+            assert verdict['row_exact']
+            assert verdict['row_dups'] == 0 and verdict['row_missing'] == 0
+
+    def test_predicate_reader_audits_without_missing(self, synthetic_dataset):
+        from petastorm_tpu.predicates import in_lambda
+        predicate = in_lambda(['id'], lambda values: values['id'] % 2 == 0)
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         predicate=predicate) as reader:
+            rows = sum(1 for _ in reader)
+            assert rows == sum(1 for r in synthetic_dataset.data
+                               if r['id'] % 2 == 0)
+            report = reader.audit().assert_complete()
+            # filtered readers are item-exact, never row-missing-audited
+            assert report['epochs'][0]['complete']
+
+    def test_sharded_loader_keeps_top_level_jit_clean(self, synthetic_dataset):
+        """ShardedJaxLoader batches stay `jax.jit`-able whole: provenance
+        rides under '_host' with the other non-HBM values, and
+        `batch_provenance_of` / `explain_batch` find it there."""
+        import jax
+        from jax.sharding import Mesh
+
+        from petastorm_tpu.jax_utils import make_jax_loader
+        from petastorm_tpu.lineage import batch_provenance_of
+        devices = np.array(jax.devices())
+        mesh = Mesh(devices.reshape(len(devices),), ('data',))
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         schema_fields=['id', 'matrix']) as reader:
+            loader = make_jax_loader(reader, batch_size=8, mesh=mesh)
+            batch = next(iter(loader))
+            assert isinstance(batch['id'], jax.Array)
+            assert PROVENANCE_KEY not in batch
+            bp = batch['_host'][PROVENANCE_KEY]
+            assert isinstance(bp, BatchProvenance) and len(bp) == 8
+            assert batch_provenance_of(batch) is bp
+            assert reader.explain_batch(batch)['rows'] == 8
+
+    def test_loader_batch_provenance_and_explain(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1, seed=11) as reader:
+            loader = JaxDataLoader(reader, batch_size=16,
+                                   shuffling_queue_capacity=64, seed=5)
+            batches = list(loader)
+            assert all(PROVENANCE_KEY in b for b in batches)
+            assert all(LINEAGE_COLUMN not in b for b in batches)
+            bp = batches[0][PROVENANCE_KEY]
+            assert isinstance(bp, BatchProvenance)
+            assert len(bp) == len(batches[0]['id'])
+            explained = reader.explain_batch(batches[0])
+            assert explained['rows'] == len(bp)
+            assert all('row_group' in s or s.get('evicted')
+                       for s in explained['sources'])
+            # the shuffle buffer mixes sources within a batch
+            assert explained['shuffle']['rows'] == len(bp)
+
+
+# -- coverage auditing --------------------------------------------------------
+
+class TestCoverageAudit:
+    def test_sharded_two_epochs_exactly_once(self, tmp_path):
+        """The acceptance scenario: 2 shards x 2 epochs, shuffle on, audits
+        as complete — every row exactly once per epoch per shard."""
+        url = 'file://' + str(tmp_path / 'sharded')
+        data = create_test_dataset(url, range(40), num_files=4)
+        reports = []
+        for shard in (0, 1):
+            seen = collections.Counter()
+            with make_reader(url, reader_pool_type='thread', workers_count=2,
+                             num_epochs=2, shuffle_row_groups=True, seed=17,
+                             cur_shard=shard, shard_count=2) as reader:
+                for row in reader:
+                    seen[int(row.id)] += 1
+                report = reader.audit().assert_complete()
+            assert report['complete'] is True
+            for epoch, verdict in report['epochs'].items():
+                assert verdict['dup_items'] == []
+                assert verdict['dropped_items'] == []
+                assert verdict['row_exact']
+                assert verdict['row_dups'] == 0
+                assert verdict['row_missing'] == 0
+            # every id this shard owns was seen exactly twice (2 epochs)
+            assert set(seen.values()) == {2}
+            reports.append(report)
+        # the two shards are disjoint and together cover the dataset
+        shard_rows = [r['epochs'][0]['rows_delivered'] for r in reports]
+        assert sum(shard_rows) == len(data)
+        skew = CoverageAuditor.shard_skew(reports)
+        assert sorted(skew['shards']) == [0, 1]
+        for verdict in skew['epochs'].values():
+            assert verdict['skew_ratio'] is not None
+            assert verdict['skew_ratio'] < 2.0
+
+    @pytest.mark.timeout(120)
+    def test_killed_process_worker_reports_drops(self, tmp_path):
+        """A worker killed mid-epoch yields REPORTED drops with their source
+        row groups — never a silent gap."""
+        url = 'file://' + str(tmp_path / 'droppy')
+        create_test_dataset(url, range(32), num_files=2)
+        reader = make_reader(url, reader_pool_type='process', workers_count=1,
+                             num_epochs=1, shuffle_row_groups=False)
+        try:
+            iterator = iter(reader)
+            next(iterator)   # at least one delivery before the kill
+            reader._pool._processes[0].kill()
+            with pytest.raises(RuntimeError):
+                # the dead pool is detected within a few polls
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    next(iterator)
+            report = reader.audit().report()
+            verdict = report['epochs'][0]
+            assert verdict['items_delivered'] >= 1
+            assert verdict['dropped_items'], 'the kill must surface as drops'
+            for dropped in verdict['dropped_items']:
+                assert dropped['path'].endswith('.parquet')
+                assert dropped['row_group'] >= 0
+            assert not verdict['complete']
+            with pytest.raises(AssertionError, match='dropped'):
+                reader.audit().assert_complete()
+        finally:
+            reader.stop()
+            reader.join()
+
+    def test_reset_starts_fresh_epoch_ledger(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            sum(1 for _ in reader)
+            first = reader.audit().assert_complete()
+            assert list(first['epochs']) == [0]
+            reader.reset()
+            sum(1 for _ in reader)
+            second = reader.audit().assert_complete()
+            # epoch numbers are globally monotone: the new pass audits in
+            # its own ledger, the finished epoch 0 verdict is untouched
+            assert sorted(second['epochs']) == [0, 1]
+            assert second['passes'] == 1
+            assert second['epochs'][0]['complete']
+            assert second['epochs'][1]['complete']
+
+    def test_shuffle_metrics_reported(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1, seed=3,
+                         shuffle_row_groups=True) as reader:
+            sum(1 for _ in reader)
+            shuffle = reader.audit().report()['epochs'][0]['shuffle']
+            assert shuffle['items'] > 0
+            for key in ('lag_mean', 'lag_p50', 'lag_max',
+                        'adjacent_source_runs', 'run_length_mean',
+                        'run_length_max'):
+                assert key in shuffle
+
+    def test_drain_keeps_audit_complete(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            next(iter(reader))
+            reader.drain()
+            # discarded-on-purpose items registered as delivered: no phantom
+            # drops in the audit
+            reader.audit().assert_complete()
+
+
+# -- quarantine ---------------------------------------------------------------
+
+class TestQuarantine:
+    def test_raise_policy_propagates(self, corrupt_dataset):
+        with pytest.raises(Exception):
+            with make_reader(corrupt_dataset, reader_pool_type='thread',
+                             workers_count=1, num_epochs=1,
+                             shuffle_row_groups=False) as reader:
+                list(reader)
+
+    def test_quarantine_policy_completes_epoch(self, corrupt_dataset):
+        with make_reader(corrupt_dataset, reader_pool_type='thread',
+                         workers_count=1, num_epochs=1,
+                         shuffle_row_groups=False,
+                         on_decode_error='quarantine') as reader:
+            rows = sum(1 for _ in reader)
+            assert rows == 23           # 24 minus the poisoned sample
+            records = reader.lineage.quarantines()
+            assert len(records) == 1
+            record = records[0]
+            assert record['stage'] == 'decode'
+            assert record['field'] == 'matrix'
+            assert record['path'].endswith('.parquet')
+            assert record['rows'] == 1
+            assert record['row_offsets'] == [0]   # 1-row groups
+            assert reader.diagnostics['rows_quarantined'] == 1
+            assert reader.diagnostics['items_quarantined'] == 1
+            report = reader.audit().assert_complete()
+            verdict = report['epochs'][0]
+            assert verdict['rows_quarantined'] == 1
+            # the poisoned item still DELIVERED (zero rows, cell-level
+            # quarantine): every ventilated item is accounted for
+            assert verdict['items_delivered'] == verdict['items_ventilated']
+            assert verdict['complete']
+
+    def test_skip_policy_counts_without_records(self, corrupt_dataset):
+        with make_reader(corrupt_dataset, reader_pool_type='thread',
+                         workers_count=1, num_epochs=1,
+                         shuffle_row_groups=False,
+                         on_decode_error='skip') as reader:
+            rows = sum(1 for _ in reader)
+            assert rows == 23
+            assert reader.lineage.quarantines() == []
+            assert reader.diagnostics['rows_quarantined'] == 1
+
+    @pytest.mark.timeout(180)
+    def test_quarantine_process_pool(self, corrupt_dataset):
+        """The quarantine record and counters cross the process boundary in
+        the accounting message."""
+        with make_reader(corrupt_dataset, reader_pool_type='process',
+                         workers_count=1, num_epochs=1,
+                         shuffle_row_groups=False,
+                         on_decode_error='quarantine') as reader:
+            rows = sum(1 for _ in reader)
+            assert rows == 23
+            records = reader.lineage.quarantines()
+            assert len(records) == 1 and records[0]['field'] == 'matrix'
+            assert reader.diagnostics['rows_quarantined'] == 1
+            reader.audit().assert_complete()
+
+    def test_transform_error_quarantines_exact_row(self, synthetic_dataset):
+        def poison(row):
+            if row['id'] == 7:
+                raise ValueError('poisoned id 7')
+            return row
+
+        spec = TransformSpec(poison)
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False, transform_spec=spec,
+                         on_decode_error='quarantine') as reader:
+            rows = sum(1 for _ in reader)
+            assert rows == len(synthetic_dataset.data) - 1
+            records = reader.lineage.quarantines()
+            assert len(records) == 1
+            assert records[0]['stage'] == 'transform'
+            assert 'poisoned id 7' in records[0]['error']
+            assert records[0]['row_offsets'] is not None
+            reader.audit().assert_complete()
+
+    def test_invalid_policy_rejected(self, synthetic_dataset):
+        with pytest.raises(ValueError, match='on_decode_error'):
+            make_reader(synthetic_dataset.url, on_decode_error='explode')
+
+
+# -- replay -------------------------------------------------------------------
+
+class TestReplay:
+    def test_replay_single_record(self, non_petastorm_dataset):
+        with make_batch_reader(non_petastorm_dataset.url,
+                               reader_pool_type='thread', workers_count=1,
+                               num_epochs=1,
+                               shuffle_row_groups=False) as reader:
+            first = next(iter(reader))
+            record = reader.last_provenance
+            for _ in reader:
+                pass
+            replayed = reader.replay(record)
+            np.testing.assert_array_equal(replayed['id'], first.id)
+            np.testing.assert_array_equal(replayed['value'], first.value)
+
+    def test_replay_shuffled_loader_batch_bit_exact(self, synthetic_dataset):
+        """The acceptance criterion: replay() of a recorded batch provenance
+        returns bit-identical rows, in batch order, across row groups."""
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1, seed=23) as reader:
+            loader = JaxDataLoader(reader, batch_size=16,
+                                   shuffling_queue_capacity=48, seed=29)
+            batches = list(loader)
+            batch = batches[1]
+            replayed = reader.replay(batch)
+            np.testing.assert_array_equal(replayed['id'], batch['id'])
+            np.testing.assert_array_equal(replayed['matrix'], batch['matrix'])
+            np.testing.assert_array_equal(replayed['image_png'],
+                                          batch['image_png'])
+
+    def test_replay_seq_handle(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=1, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            next(iter(reader))
+            seq = reader.last_seq
+            for _ in reader:
+                pass
+            replayed = reader.replay(seq)
+            assert len(replayed['id']) == reader.lineage.resolve(seq).rows
+
+
+# -- endpoint / flight record -------------------------------------------------
+
+class TestSurfaces:
+    def test_coverage_endpoint(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1, debug_port=0,
+                         shuffle_row_groups=False) as reader:
+            sum(1 for _ in reader)
+            status, body = _http_get(reader.debug_port, '/coverage')
+            assert status == 200
+            report = json.loads(body)
+            assert report['enabled'] is True
+            assert report['epochs']['0']['complete'] is True
+            # /diagnostics folds the coverage audit in
+            status, body = _http_get(reader.debug_port, '/diagnostics')
+            assert status == 200
+            assert 'coverage' in json.loads(body)
+
+    def test_flight_record_carries_lineage(self, synthetic_dataset, tmp_path):
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            sum(1 for _ in reader)
+            path = reader.dump_flight_record(
+                path=str(tmp_path / 'flight.json'))
+            with open(path) as f:
+                record = json.load(f)
+            assert record['lineage']['enabled'] is True
+            assert record['lineage']['epochs']['0']['complete'] is True
+            assert 'recent_quarantines' in record['lineage']
+
+
+# -- kill switch --------------------------------------------------------------
+
+class TestKillSwitch:
+    def test_lineage_env_gate(self, monkeypatch):
+        monkeypatch.delenv('PETASTORM_TPU_LINEAGE', raising=False)
+        assert lineage_enabled()
+        monkeypatch.setenv('PETASTORM_TPU_LINEAGE', '0')
+        assert not lineage_enabled()
+
+    def test_disabled_publishes_nothing(self, synthetic_dataset, monkeypatch):
+        monkeypatch.setenv('PETASTORM_TPU_LINEAGE', '0')
+        with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            loader = JaxDataLoader(reader, batch_size=16)
+            batches = list(loader)
+            assert all(PROVENANCE_KEY not in b for b in batches)
+            assert reader.last_provenance is None
+            report = reader.lineage.coverage_report()
+            assert report['enabled'] is False
+            assert report['records_registered'] == 0

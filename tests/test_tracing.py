@@ -11,7 +11,7 @@ import pytest
 from petastorm_tpu.jax_utils import JaxDataLoader, prefetch_to_device
 from petastorm_tpu.reader import make_columnar_reader, make_reader
 from petastorm_tpu.tracing import (MetricsEmitter, Tracer, make_span,
-                                   resolve_trace)
+                                   prometheus_text, resolve_trace)
 
 
 def _assert_valid_chrome_trace(path, expect_names=(), min_pids=1):
@@ -358,6 +358,102 @@ class TestReaderShutdownLifecycle:
         assert reader._debug_server._thread is None
         assert _petastorm_threads() == [], \
             'dangling petastorm threads after unclean pool death'
+
+
+#: One Prometheus text-exposition sample line: metric name, single space,
+#: then a float literal or the spec's NaN/+Inf/-Inf — what a scrape parser
+#: accepts (anything else is a formatter bug).
+_PROM_SAMPLE = __import__('re').compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]* '
+    r'(?:[+-]?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)|NaN|\+Inf|-Inf)$')
+
+
+class TestPrometheusText:
+    def test_every_sample_line_parses(self):
+        snapshot = {'worker_io_s': 1.25, 'items_out': 42, 'window_s': 0.0,
+                    'tiny': 1e-07, 'huge': 3.5e18}
+        lines = prometheus_text(snapshot).strip().splitlines()
+        samples = [line for line in lines if not line.startswith('#')]
+        assert len(samples) == len(snapshot)
+        for line in samples:
+            assert _PROM_SAMPLE.match(line), line
+
+    def test_help_and_type_precede_each_sample(self):
+        lines = prometheus_text({'a': 1, 'b': 2.5}).strip().splitlines()
+        assert lines[0].startswith('# HELP petastorm_tpu_a ')
+        assert lines[1] == '# TYPE petastorm_tpu_a gauge'
+        assert lines[2].startswith('petastorm_tpu_a ')
+        assert lines[3].startswith('# HELP petastorm_tpu_b ')
+
+    def test_non_finite_values_use_spec_literals(self):
+        text = prometheus_text({'nan_ratio': float('nan'),
+                                'pos': float('inf'),
+                                'neg': -float('inf')})
+        samples = [line for line in text.strip().splitlines()
+                   if not line.startswith('#')]
+        values = dict(line.split(' ', 1) for line in samples)
+        assert values['petastorm_tpu_nan_ratio'] == 'NaN'
+        assert values['petastorm_tpu_pos'] == '+Inf'
+        assert values['petastorm_tpu_neg'] == '-Inf'
+        # none of the python reprs a scrape parser rejects
+        assert 'nan' not in values.values() and 'inf' not in values.values()
+        for line in samples:
+            assert _PROM_SAMPLE.match(line), line
+
+    def test_non_numeric_values_skipped(self):
+        text = prometheus_text({'s': 'str', 'flag': True, 'ok': 1.0})
+        assert 'petastorm_tpu_s' not in text
+        assert 'petastorm_tpu_flag' not in text
+        assert 'petastorm_tpu_ok' in text
+
+
+class TestAtomicExports:
+    def test_chrome_trace_export_is_atomic(self, tmp_path):
+        tracer = Tracer()
+        tracer.add_span('x', 'cat', 0.0, 1.0)
+        path = tmp_path / 'trace.json'
+        tracer.export_chrome_trace(str(path))
+        # the tmp file never survives a completed export, and the artifact
+        # is whole JSON
+        leftovers = [p for p in tmp_path.iterdir() if '.tmp.' in p.name]
+        assert leftovers == []
+        with open(path) as f:
+            assert json.load(f)['traceEvents']
+
+    def test_failed_export_leaves_previous_file_intact(self, tmp_path,
+                                                       monkeypatch):
+        tracer = Tracer()
+        tracer.add_span('x', 'cat', 0.0, 1.0)
+        path = tmp_path / 'trace.json'
+        tracer.export_chrome_trace(str(path))
+        before = path.read_text()
+
+        def boom(*_a, **_k):
+            raise OSError('disk full mid-dump')
+
+        monkeypatch.setattr(json, 'dump', boom)
+        with pytest.raises(OSError):
+            tracer.export_chrome_trace(str(path))
+        # previous good export untouched; no truncated tmp file left behind
+        assert path.read_text() == before
+        assert [p for p in tmp_path.iterdir() if '.tmp.' in p.name] == []
+
+    def test_flight_record_write_is_atomic(self, tmp_path, monkeypatch):
+        from petastorm_tpu.health import write_flight_record
+        path = tmp_path / 'flight.json'
+        write_flight_record(str(path), {'ok': 1})
+        with open(path) as f:
+            assert json.load(f) == {'ok': 1}
+
+        def boom(*_a, **_k):
+            raise OSError('disk full mid-dump')
+
+        monkeypatch.setattr(json, 'dump', boom)
+        with pytest.raises(OSError):
+            write_flight_record(str(path), {'ok': 2})
+        with open(path) as f:
+            assert json.load(f) == {'ok': 1}
+        assert [p for p in tmp_path.iterdir() if '.tmp.' in p.name] == []
 
 
 class TestTraceOverheadQuickBench:
