@@ -133,6 +133,15 @@ def main():
         run_lineage_overhead_bench
     lineage_overhead = run_lineage_overhead_bench(quick=True)
 
+    # -- shared cache: K readers x one dataset, decoded once ----------------
+    # Quick mode asserts the decode-once invariant and warm-vs-roofline; the
+    # >=2x aggregate headline lives in BENCH_r11.json from the full run.
+    from petastorm_tpu.benchmark.shared_cache import run_shared_cache_bench
+    shared_cache = run_shared_cache_bench(quick=True)
+    # per_reader detail is full-run/artifact material, not headline JSON
+    shared_cache['shared'].pop('per_reader', None)
+    shared_cache['local_disk_baseline'].pop('per_reader', None)
+
     # -- north-star: train-step infeed overlap ------------------------------
     # Accelerator-scale configs for any non-CPU backend; dataset paths carry
     # the size parameters so a platform change can't reuse a stale store.
@@ -310,6 +319,7 @@ def main():
         'readahead': readahead,
         'trace_overhead': trace_overhead,
         'lineage_overhead': lineage_overhead,
+        'shared_cache': shared_cache,
         'northstar': {
             'platform': platform,
             'mnist_train': mnist.as_dict(),

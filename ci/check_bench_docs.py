@@ -43,16 +43,17 @@ ANNOTATION = re.compile(
 #: annotated (MIN_ANNOTATIONS guards against the gate being emptied out)
 DEFAULT_DOCS = ('docs/benchmarks.md', 'docs/transport.md',
                 'docs/readahead.md', 'docs/tracing.md', 'docs/health.md',
-                'docs/lineage.md')
+                'docs/lineage.md', 'docs/cache.md')
 MIN_ANNOTATIONS = 30
 
 #: Artifacts that MUST be quoted by at least one annotation across the
 #: default docs: a recorded benchmark nobody displays is a claim nobody can
 #: check (round-9 extension — BENCH_r09 must be referenced from the docs,
 #: and the earlier per-PR artifacts stay referenced too; round-10 adds
-#: BENCH_r10, the lineage-overhead record).
+#: BENCH_r10, the lineage-overhead record; round-11 adds BENCH_r11, the
+#: shared-cache decode-once record).
 REQUIRED_ARTIFACTS = ('BENCH_r06.json', 'BENCH_r07.json', 'BENCH_r08.json',
-                      'BENCH_r09.json', 'BENCH_r10.json')
+                      'BENCH_r09.json', 'BENCH_r10.json', 'BENCH_r11.json')
 
 
 def _lookup(blob, keypath: str):

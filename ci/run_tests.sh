@@ -49,6 +49,12 @@ python -m pytest tests/test_lineage.py -q
 echo '== lineage-overhead quick bench (provenance+audit ledgers on vs off) =='
 python -m petastorm_tpu.benchmark.lineage_overhead --quick
 
+echo '== shared-cache quick checks (tiered segments, pins, concurrent attach) =='
+python -m pytest tests/test_sharedcache.py -q
+
+echo '== shared-cache quick bench (K readers x one dataset, decoded once) =='
+python -m petastorm_tpu.benchmark.shared_cache --quick
+
 echo '== bench-docs consistency gate =='
 python ci/check_bench_docs.py
 

@@ -15,7 +15,7 @@ __all__ = ['make_reader', 'make_batch_reader', 'make_columnar_reader',
            'WeightedIndexedMixture',
            'TransformSpec', 'NoDataAvailableError',
            'make_jax_loader', 'make_dataset_converter', 'materialize_dataset',
-           'CoverageAuditor', 'Provenance',
+           'CoverageAuditor', 'Provenance', 'SharedRowGroupCache',
            '__version__']
 
 
@@ -45,4 +45,7 @@ def __getattr__(name):
     if name in ('CoverageAuditor', 'Provenance'):
         from petastorm_tpu import lineage
         return getattr(lineage, name)
+    if name == 'SharedRowGroupCache':
+        from petastorm_tpu.sharedcache import SharedRowGroupCache
+        return SharedRowGroupCache
     raise AttributeError('module {!r} has no attribute {!r}'.format(__name__, name))

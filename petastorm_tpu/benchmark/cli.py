@@ -71,6 +71,21 @@ def build_parser() -> argparse.ArgumentParser:
                              'measured samples, so the in-flight tail epoch '
                              'honestly reads as dropped; judge the fully '
                              'consumed epochs (see docs/lineage.md)')
+    parser.add_argument('--cache-type', default='null',
+                        choices=['null', 'local-disk', 'shared'],
+                        help="row-group cache: 'null' (none), 'local-disk' "
+                             "(per-reader pickle-on-disk), 'shared' (host-"
+                             'wide tiered decoded cache that concurrent '
+                             'readers attach to; see docs/cache.md)')
+    parser.add_argument('--cache-location', metavar='DIR', default=None,
+                        help='cache directory (required for local-disk and '
+                             'shared; for shared it is the host-wide root '
+                             'every attaching reader must agree on)')
+    parser.add_argument('--cache-size-limit', type=int, default=None,
+                        help='cache byte budget (required for local-disk '
+                             'and shared; shared bounds the disk tier, with '
+                             'the shared-memory tier capped at min(this, '
+                             '1 GiB))')
     parser.add_argument('--on-decode-error', default='raise',
                         choices=['raise', 'skip', 'quarantine'],
                         help="bad-sample policy: 'raise' propagates decode/"
@@ -89,6 +104,10 @@ def main(argv=None) -> int:
                     else int(args.io_readahead))
     if args.metrics_interval and not args.metrics_out:
         raise SystemExit('--metrics-interval needs --metrics-out PATH')
+    if args.cache_type != 'null' and not (args.cache_location
+                                          and args.cache_size_limit):
+        raise SystemExit('--cache-type {} needs --cache-location and '
+                         '--cache-size-limit'.format(args.cache_type))
     results = [reader_throughput(
         args.dataset_url, field_regex=args.field_regex,
         warmup_cycles=args.warmup_cycles, measure_cycles=args.measure_cycles,
@@ -100,7 +119,9 @@ def main(argv=None) -> int:
         metrics_interval=args.metrics_interval,
         metrics_out=args.metrics_out, debug_port=args.debug_port,
         stall_timeout=args.stall_timeout, audit=args.audit,
-        on_decode_error=args.on_decode_error)
+        on_decode_error=args.on_decode_error, cache_type=args.cache_type,
+        cache_location=args.cache_location,
+        cache_size_limit=args.cache_size_limit)
         for _ in range(max(1, args.runs))]
     # headline = median run: the honest central figure (best would overstate)
     by_rate = sorted(results, key=lambda r: r.samples_per_sec)

@@ -71,7 +71,10 @@ def reader_throughput(dataset_url: str,
                       debug_port=None,
                       stall_timeout: float = 0,
                       audit: bool = False,
-                      on_decode_error: str = 'raise') -> ThroughputResult:
+                      on_decode_error: str = 'raise',
+                      cache_type: str = 'null',
+                      cache_location: Optional[str] = None,
+                      cache_size_limit: Optional[int] = None) -> ThroughputResult:
     """Measure reader throughput on ``dataset_url``.
 
     ``read_method='python'`` iterates raw reader rows/batches;
@@ -94,7 +97,9 @@ def reader_throughput(dataset_url: str,
                   num_epochs=None, io_readahead=io_readahead, trace=trace,
                   metrics_interval=metrics_interval, metrics_out=metrics_out,
                   debug_port=debug_port, stall_timeout=stall_timeout,
-                  on_decode_error=on_decode_error)
+                  on_decode_error=on_decode_error, cache_type=cache_type,
+                  cache_location=cache_location,
+                  cache_size_limit=cache_size_limit)
     if field_regex is not None:
         kwargs['schema_fields'] = field_regex
 

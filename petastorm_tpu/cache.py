@@ -18,6 +18,14 @@ from abc import ABC, abstractmethod
 
 logger = logging.getLogger(__name__)
 
+#: Re-seed the :class:`LocalDiskCache` running byte total from a directory
+#: scan every N stores: the total is per-process, so with several worker
+#: processes writing to one cache directory each process only sees its own
+#: stores and the counter drifts from reality. A periodic scan (plus an
+#: immediate one whenever the counter goes negative — proof of staleness)
+#: bounds the drift without paying O(entries) syscalls per store.
+RESEED_SCAN_EVERY = 256
+
 
 class CacheBase(ABC):
     @abstractmethod
@@ -52,7 +60,8 @@ class LocalDiskCache(CacheBase):
         self._size_limit = size_limit_bytes
         self._shards = shards
         self._cleanup_on_exit = cleanup
-        self._approx_total = None  # running byte total, seeded by one scan
+        self._approx_total = None  # running byte total, re-seeded by scans
+        self._stores_since_scan = 0
         for shard in range(shards):
             os.makedirs(os.path.join(path, 'shard_{:02d}'.format(shard)), exist_ok=True)
 
@@ -122,10 +131,15 @@ class LocalDiskCache(CacheBase):
     def _evict_if_needed(self, incoming_bytes: int) -> None:
         # A full directory scan per store is O(cached entries) in syscalls;
         # keep a running total (seeded by one scan) and only rescan when the
-        # counter crosses the limit. The counter may drift under concurrent
-        # writers — the rescan at eviction time corrects it.
-        if self._approx_total is None:
+        # counter crosses the limit. The counter drifts under concurrent
+        # multi-process writers (each process only observes its own stores):
+        # re-seed whenever it goes negative — proof of staleness — and every
+        # RESEED_SCAN_EVERY stores so drift stays bounded either way.
+        self._stores_since_scan += 1
+        if (self._approx_total is None or self._approx_total < 0
+                or self._stores_since_scan >= RESEED_SCAN_EVERY):
             self._approx_total = sum(size for _, size, _ in self._entries())
+            self._stores_since_scan = 0
         self._approx_total += incoming_bytes
         if self._approx_total <= self._size_limit:
             return
@@ -148,4 +162,16 @@ class LocalDiskCache(CacheBase):
         if not self._cleanup_on_exit:
             return
         import shutil
+        # Remove each shard dir ATOMICALLY (rename-then-rmtree): a
+        # concurrent reader sees either the complete shard or none of it —
+        # never a half-deleted tree whose surviving entries would be served
+        # while their neighbors vanish mid-listing.
+        for shard in range(self._shards):
+            shard_dir = os.path.join(self._path, 'shard_{:02d}'.format(shard))
+            doomed = '{}.removing.{}'.format(shard_dir, os.getpid())
+            try:
+                os.rename(shard_dir, doomed)
+            except OSError:
+                continue
+            shutil.rmtree(doomed, ignore_errors=True)
         shutil.rmtree(self._path, ignore_errors=True)

@@ -78,6 +78,12 @@ def _validate_io_readahead(io_readahead):
                      '{!r}'.format(io_readahead))
 
 
+#: Valid ``cache_type`` values for every reader factory (see
+#: ``docs/cache.md``): no caching, a per-reader pickle-on-disk cache, or the
+#: host-wide tiered shared decoded cache.
+CACHE_TYPES = ('null', 'local-disk', 'shared')
+
+
 def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
                 cache_extra_settings):
     if cache_type in (None, 'null'):
@@ -89,7 +95,22 @@ def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_est
         return LocalDiskCache(cache_location, cache_size_limit,
                               cache_row_size_estimate or 0,
                               **(cache_extra_settings or {}))
-    raise ValueError('Unknown cache_type {!r}'.format(cache_type))
+    if cache_type == 'shared':
+        if not cache_location or not cache_size_limit:
+            raise ValueError("cache_type='shared' needs cache_location and "
+                             'cache_size_limit')
+        from petastorm_tpu.sharedcache import (SharedRowGroupCache,
+                                               shared_cache_enabled)
+        if not shared_cache_enabled():
+            # kill switch: no attachment, no files, no shared state at all
+            logger.warning(
+                "cache_type='shared' disabled via %s=0; reads are uncached",
+                'PETASTORM_TPU_SHARED_CACHE')
+            return NullCache()
+        return SharedRowGroupCache(cache_location, cache_size_limit,
+                                   **(cache_extra_settings or {}))
+    raise ValueError('cache_type must be one of {}; got {!r}'.format(
+        ', '.join(repr(t) for t in CACHE_TYPES), cache_type))
 
 
 def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer,
@@ -178,6 +199,15 @@ def make_reader(dataset_url,
     the parquet reads of its next K ventilated pieces while it decodes the
     current one, overlapping storage latency with decode CPU; ``'auto'``
     sizes K from the live io:decode ratio (see ``docs/readahead.md``).
+
+    ``cache_type`` picks the row-group cache: ``'null'`` (none, the
+    default), ``'local-disk'`` (per-reader pickle-on-disk), or ``'shared'``
+    — the host-wide tiered cache (shared-memory decoded segments, disk
+    spill) that N concurrent readers and their worker processes attach to
+    so each row group is read+decoded ONCE per host; a shared-tier miss
+    still prefetches via the readahead planner with coalesced remote reads.
+    Shared-cache hits return **read-only** zero-copy views. Kill switch:
+    ``PETASTORM_TPU_SHARED_CACHE=0``. See ``docs/cache.md``.
 
     ``trace=True`` (or the ``PETASTORM_TPU_TRACE`` env var) records per-item
     spans for every pipeline stage into ``reader.tracer``, exportable as

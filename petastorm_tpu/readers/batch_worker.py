@@ -73,8 +73,8 @@ class ArrowBatchWorker(ParquetPieceWorker):
                 table = self._load_table_with_predicate(piece, worker_predicate)
             else:
                 cache_key = self._cache_key('batch', piece)
-                table = self._local_cache.get(cache_key,
-                                              lambda: self._load_table(piece))
+                table = self._cached_load(cache_key,
+                                          lambda: self._load_table(piece))
         except Exception as e:  # noqa: BLE001 - policy decides
             if not self._quarantine_item('decode', e):
                 raise
@@ -125,6 +125,9 @@ class ArrowBatchWorker(ParquetPieceWorker):
     def _planned_columns(self, piece):
         # the no-predicate path reads exactly _load_table's column list
         return self._stored_columns(list(self._schema.fields.keys()), piece)
+
+    def _planned_cache_key(self, piece, params):
+        return self._cache_key('batch', piece)
 
     def _load_table(self, piece) -> pa.Table:
         columns = self._stored_columns(list(self._schema.fields.keys()), piece)

@@ -345,7 +345,7 @@ class ColumnarWorker(ParquetPieceWorker):
                 # schema edits) so editing the transform invalidates entries.
                 cache_key = self._cache_key(
                     'columnar_tx:' + self._transform_key, piece)
-                columns = self._local_cache.get(
+                columns = self._cached_load(
                     cache_key, lambda: self._apply_transform(self._load(piece)))
                 if columns and len(next(iter(columns.values()))):
                     n = len(next(iter(columns.values())))
@@ -359,8 +359,8 @@ class ColumnarWorker(ParquetPieceWorker):
                 columns = self._load_with_predicate(piece, worker_predicate)
             else:
                 cache_key = self._cache_key('columnar', piece)
-                columns = self._local_cache.get(cache_key,
-                                                lambda: self._load(piece))
+                columns = self._cached_load(cache_key,
+                                            lambda: self._load(piece))
         except Exception as e:  # noqa: BLE001 - policy decides
             if not self._quarantine_item('decode', e):
                 raise
@@ -409,6 +409,14 @@ class ColumnarWorker(ParquetPieceWorker):
     def _planned_columns(self, piece):
         # every no-predicate branch of process() funnels through _load()
         return self._stored_columns(list(self._schema.fields.keys()), piece)
+
+    def _planned_cache_key(self, piece, params):
+        # mirror process(): whole-group transform items cache post-transform
+        partition = params.get('shuffle_row_drop_partition', (0, 1))
+        if self._transform_spec is not None and partition[1] == 1:
+            return self._cache_key('columnar_tx:' + self._transform_key,
+                                   piece)
+        return self._cache_key('columnar', piece)
 
     def _load(self, piece) -> Dict[str, np.ndarray]:
         names = list(self._schema.fields.keys())

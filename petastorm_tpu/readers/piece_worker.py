@@ -141,6 +141,12 @@ class ParquetPieceWorker(WorkerBase):
         # per ventilated piece
         self._dataset_path_digest = hashlib.md5(
             str(self._dataset_path).encode()).hexdigest()
+        # the column view partitions the cache: two readers over the same
+        # store with different schema_fields must not serve each other
+        # narrower/wider payloads (the shared host-wide cache makes such
+        # cross-reader collisions routine, not hypothetical)
+        self._view_digest = hashlib.md5(
+            ','.join(sorted(self._schema.fields)).encode()).hexdigest()[:12]
         # -- lineage / quarantine (see petastorm_tpu/lineage.py) ---------------
         self._on_decode_error = validate_decode_error_policy(
             args.get('on_decode_error', 'raise') if isinstance(args, dict)
@@ -187,6 +193,11 @@ class ParquetPieceWorker(WorkerBase):
         if self._prefetch_files is not None:
             self._prefetch_files.close_all()
         self._open_files.close_all()
+        close_cache = getattr(self._local_cache, 'close', None)
+        if close_cache is not None:
+            # shared cache: flush host-wide counters and release this
+            # process's pins (idempotent — thread workers share one instance)
+            close_cache()
 
     def _open_parquet(self, path: str) -> pq.ParquetFile:
         handle = self._filesystem.open(path, 'rb')
@@ -236,12 +247,22 @@ class ParquetPieceWorker(WorkerBase):
         params.update(item_kwargs)
         if params.get('worker_predicate') is not None:
             return None
-        if not isinstance(self._local_cache, NullCache):
-            return None
         piece_index = params.get('piece_index')
         if piece_index is None:
             return None
         piece = self._split_pieces[piece_index]
+        if not isinstance(self._local_cache, NullCache):
+            # Tier-2 remote prefetch (docs/cache.md): with the SHARED cache,
+            # only keys the host does not already hold are worth reading —
+            # plan the background (pre_buffer-coalesced) read for misses and
+            # skip hits entirely. Per-reader caches (local-disk) keep the old
+            # behavior: a maybe-cached item is not plannable.
+            contains = getattr(self._local_cache, 'contains', None)
+            if contains is None:
+                return None
+            cache_key = self._planned_cache_key(piece, params)
+            if cache_key is None or contains(cache_key):
+                return None
         columns = self._planned_columns(piece)
         if columns is None:
             return None
@@ -251,6 +272,13 @@ class ParquetPieceWorker(WorkerBase):
         """The exact column list the subclass's no-predicate load will pass to
         :meth:`_read_row_group` for ``piece`` (``None`` = not plannable).
         Overridden per worker type."""
+        return None
+
+    def _planned_cache_key(self, piece, params) -> Optional[str]:
+        """The exact cache key the subclass's no-predicate load will consult
+        for this ventilated item (``None`` = the load is not cached), so the
+        readahead planner can peek the shared cache before scheduling a
+        prefetch. Overridden per worker type."""
         return None
 
     @staticmethod
@@ -479,10 +507,29 @@ class ParquetPieceWorker(WorkerBase):
             out[name] = arr
         return out, kept
 
+    def _cached_load(self, cache_key: str, fill):
+        """``self._local_cache.get`` plus telemetry: shared-cache hit/miss/
+        eviction deltas land in ``ReaderStats`` (and from there in
+        ``/metrics``, ``/diagnostics``, flight records). A blocked
+        single-flight wait beats ``io`` so the watchdog attributes it."""
+        cache = self._local_cache
+        take_events = getattr(cache, 'take_events', None)
+        if take_events is None:
+            return cache.get(cache_key, fill)
+        self.beat('io')   # a cross-process fill wait is storage-side stall
+        value = cache.get(cache_key, fill)
+        for name, n in take_events().items():
+            if n:
+                self.record_count(name, n)
+        self.record_gauge('shared_cache_bytes', cache.occupancy_bytes())
+        return value
+
     def _cache_key(self, prefix: str, piece) -> str:
         # decode_hints change what a decoded row group contains (e.g. image
-        # resolution) — they must partition the cache, or a reader with
-        # different hints would be served wrong-resolution data
-        return '{}:{}:{}:{}{}'.format(
-            prefix, self._dataset_path_digest,
+        # resolution) — they must partition the cache, as must the column
+        # view (host-wide shared tiers serve MANY readers; see docs/cache.md
+        # for the full key schema) — otherwise a reader with different hints
+        # or fields would be served wrong payloads
+        return '{}:{}:{}:{}:{}{}'.format(
+            prefix, self._dataset_path_digest, self._view_digest,
             piece.path, piece.row_group, self._decode_hints_digest)

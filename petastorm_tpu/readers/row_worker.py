@@ -146,8 +146,8 @@ class RowGroupWorker(ParquetPieceWorker):
                 rows = self._load_rows_with_predicate(piece, worker_predicate)
             else:
                 cache_key = self._cache_key('rowgroup', piece)
-                rows = self._local_cache.get(cache_key,
-                                             lambda: self._load_rows(piece))
+                rows = self._cached_load(cache_key,
+                                         lambda: self._load_rows(piece))
         except Exception as e:  # noqa: BLE001 - policy decides
             if not self._quarantine_item('decode', e):
                 raise
@@ -237,7 +237,7 @@ class RowGroupWorker(ParquetPieceWorker):
 
     def _form_window_chunk(self, piece, shuffle_row_drop_partition):
         cache_key = self._cache_key('ngram_cols', piece)
-        columns = self._local_cache.get(
+        columns = self._cached_load(
             cache_key, lambda: self._load_window_columns(piece))
         partition, num_partitions = shuffle_row_drop_partition
         if num_partitions > 1:
@@ -270,6 +270,13 @@ class RowGroupWorker(ParquetPieceWorker):
         else:
             names = list(self._schema.fields.keys())
         return self._stored_columns(names, piece)
+
+    def _planned_cache_key(self, piece, params):
+        # mirror process(): the plain-ngram branch caches decoded window
+        # columns; every other no-predicate item caches decoded row dicts
+        if self._ngram is not None and self._transform_spec is None:
+            return self._cache_key('ngram_cols', piece)
+        return self._cache_key('rowgroup', piece)
 
     def _read_columns(self, piece, columns: List[str]):
         return self._read_row_group(piece, columns)

@@ -1,0 +1,975 @@
+"""Host-wide tiered shared row-group cache (ROADMAP item 4).
+
+The per-reader caches in :mod:`petastorm_tpu.cache` store **compressed or
+pickled** payloads per reader: K concurrent readers on one host decode the
+same row groups K times. This module is the structural fix — a host-wide
+cache of **post-decode** payloads that every reader (and each of its
+process-pool workers) attaches to:
+
+- **Tier 0 — shared memory.** Decoded payloads are published as mmap-backed
+  segment files in ``/dev/shm`` (falling back to the cache location when no
+  shm filesystem exists). ``pa.Table`` payloads are written as an Arrow IPC
+  stream and re-opened zero-copy over the mapping; numpy-column dicts and
+  row lists travel as pickle protocol-5 frames whose out-of-band buffers
+  reconstruct as **read-only** ndarray views over the mapping — the same
+  buffer-protocol deserialization contract as the PR-1 zero-copy transport
+  (``docs/transport.md``). A hit costs an ``mmap`` + pointer fix-up; no
+  storage read, no codec decode.
+- **Tier 1 — disk.** Segments evicted from tier 0 spill to a disk directory
+  in the same format (superseding the pickle ``LocalDiskCache`` for
+  row-group payloads); a tier-1 hit is promoted back to tier 0.
+- **Tier 2 — remote prefetch.** Misses fall through to the worker's normal
+  read path, where the PR-2 readahead planner prefetches the exact
+  ``(file, row_group, columns)`` read in the background and remote
+  filesystems use ``pre_buffer`` coalesced range reads
+  (``ParquetPieceWorker._plan_item`` consults :meth:`SharedRowGroupCache.contains`
+  so only *missing* keys are prefetched).
+
+Concurrency and crash-safety contracts:
+
+- **Lock-free reads.** A segment is located by the digest of its key (the
+  directory IS the index); readers never take a lock. Writers publish via
+  write-to-temp + ``os.replace``, so a reader observes either the complete
+  previous segment or the complete new one.
+- **Single-flight fills.** The first process to miss a key takes a lock
+  file (``O_CREAT | O_EXCL``) and decodes; concurrent missers wait for the
+  segment instead of decoding the same bytes again. A lock whose holder pid
+  is dead (or that outlives ``lock_timeout_s``) is stolen — a crashed
+  filler never wedges the host.
+- **Ref-counted pins.** Attaching a segment drops a pin file naming the
+  attaching pid; eviction skips pinned segments. A dead reader's pins
+  expire automatically (pid liveness is checked at eviction time), so a
+  crash never leaks pinned memory. Unpinned eviction while a mapping is
+  live is still safe on POSIX — the unlinked file's pages stay valid until
+  the last view drops.
+- **Truncation detection.** Every segment carries a sized header and a
+  trailer magic; a segment whose byte length disagrees with its frame table
+  (a torn copy, a truncated spill) is dropped and refilled, never served.
+
+Keys are built by ``ParquetPieceWorker._cache_key``:
+``(payload kind, dataset digest, column-view digest, file, row_group,
+decode-hints digest)`` — everything that changes what a decoded row group
+contains partitions the cache.
+
+Kill switch: ``PETASTORM_TPU_SHARED_CACHE=0`` makes ``cache_type='shared'``
+fall back to :class:`~petastorm_tpu.cache.NullCache` — no attachment, no
+files, no shared state. See ``docs/cache.md``.
+"""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import json
+import logging
+import mmap
+import os
+import pickle
+import struct
+import tempfile
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from petastorm_tpu.cache import CacheBase
+
+logger = logging.getLogger(__name__)
+
+#: Set to ``0``/``false``/``off`` to disable shared-cache attachment
+#: entirely: ``cache_type='shared'`` then degrades to a NullCache.
+SHARED_CACHE_ENV_VAR = 'PETASTORM_TPU_SHARED_CACHE'
+
+_SEGMENT_MAGIC = b'PTSC'
+_SEGMENT_TRAILER = b'CSTP'
+_SEGMENT_VERSION = 1
+#: Segment payload encodings.
+KIND_PICKLE5 = 1     # frames: [pickle meta, out-of-band buffer 0..N]
+KIND_ARROW_IPC = 2   # frames: [arrow IPC stream]
+
+_HEADER = struct.Struct('<4sHHI')     # magic, version, kind, nframes
+_FRAME_LEN = struct.Struct('<Q')
+#: Frame payloads start on 64-byte boundaries so reconstructed ndarray views
+#: are cache-line aligned (numpy tolerates unaligned, but why pay for it).
+_FRAME_ALIGN = 64
+
+#: Buffers below this pickle in-band (framing a tiny array costs more than
+#: one memcpy); large decoded columns go out-of-band and attach zero-copy.
+_OOB_THRESHOLD_BYTES = 4096
+
+#: Default tier-0 (shared-memory) budget when the caller only sizes the
+#: disk tier. /dev/shm defaults to half of RAM; stay well under it.
+_DEFAULT_MEM_LIMIT_BYTES = 1 << 30
+
+#: How many attached segments a single cache instance keeps pinned; older
+#: attachments are unpinned (their mappings stay alive for as long as any
+#: returned array references them).
+_DEFAULT_ATTACH_LIMIT = 16
+
+#: Counter flush granularity: per-process counter files are rewritten every
+#: N events (and at close) so `global_counters` lags bounded, not forever.
+_COUNTER_FLUSH_EVERY = 32
+
+#: Counter files of DEAD processes older than this are swept at attach
+#: time, bounding the counters directory on a long-lived cache root. The
+#: TTL keeps recently-exited readers summable (the decode-once benchmark
+#: reads `global_counters` after its fleet exits); note totals therefore
+#: accumulate across runs within the TTL — compare deltas, or use a fresh
+#: root, when asserting per-run invariants.
+_COUNTER_TTL_S = 3600.0
+
+
+def shared_cache_enabled() -> bool:
+    """The :data:`SHARED_CACHE_ENV_VAR` kill switch (default: enabled)."""
+    return os.environ.get(SHARED_CACHE_ENV_VAR, '1').strip().lower() \
+        not in ('0', 'false', 'off', 'no')
+
+
+class CorruptSegmentError(Exception):
+    """A segment file failed structural validation (truncated or torn);
+    it is dropped and refilled, never served."""
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True      # exists, owned by someone else
+    except OSError:
+        return False
+    return True
+
+
+# -- segment file format -------------------------------------------------------
+
+def write_segment(path: str, kind: int, frames: List) -> int:
+    """Atomically publish ``frames`` as a segment file at ``path``; returns
+    the byte size written. Frames may be any buffer-protocol objects."""
+    views = [memoryview(f) for f in frames]
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix='.tmp')
+    try:
+        with os.fdopen(fd, 'wb') as f:
+            f.write(_HEADER.pack(_SEGMENT_MAGIC, _SEGMENT_VERSION, kind,
+                                 len(views)))
+            for view in views:
+                f.write(_FRAME_LEN.pack(view.nbytes))
+            offset = _HEADER.size + _FRAME_LEN.size * len(views)
+            for view in views:
+                pad = (-offset) % _FRAME_ALIGN
+                if pad:
+                    f.write(b'\0' * pad)
+                    offset += pad
+                f.write(view)
+                offset += view.nbytes
+            f.write(_SEGMENT_TRAILER)
+            size = offset + len(_SEGMENT_TRAILER)
+        os.replace(tmp, path)
+        return size
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def read_segment(path: str) -> Tuple[int, List[memoryview], mmap.mmap]:
+    """Map a segment file and return ``(kind, frame views, mapping)``.
+
+    The views are zero-copy, **read-only** slices of the mapping; they (and
+    anything reconstructed over them) keep the mapping alive via their
+    ``obj`` reference, so the caller may drop the returned mapping handle
+    freely. Raises :class:`CorruptSegmentError` on any structural mismatch
+    — a truncated segment is detected here, before a single payload byte is
+    interpreted."""
+    with open(path, 'rb') as f:
+        try:
+            mapping = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError:
+            # a zero-length file cannot be mapped; it is also not a segment
+            raise CorruptSegmentError('empty segment file')
+    try:
+        total = len(mapping)
+        if total < _HEADER.size + len(_SEGMENT_TRAILER):
+            raise CorruptSegmentError('segment shorter than its header')
+        magic, version, kind, nframes = _HEADER.unpack_from(mapping, 0)
+        if magic != _SEGMENT_MAGIC or version != _SEGMENT_VERSION:
+            raise CorruptSegmentError('bad segment magic/version')
+        table_end = _HEADER.size + _FRAME_LEN.size * nframes
+        if total < table_end + len(_SEGMENT_TRAILER):
+            raise CorruptSegmentError('segment truncated inside frame table')
+        lengths = [_FRAME_LEN.unpack_from(
+            mapping, _HEADER.size + i * _FRAME_LEN.size)[0]
+            for i in range(nframes)]
+        offset = table_end
+        spans = []
+        for length in lengths:
+            offset += (-offset) % _FRAME_ALIGN
+            spans.append((offset, length))
+            offset += length
+        if (total != offset + len(_SEGMENT_TRAILER)
+                or mapping[offset:offset + len(_SEGMENT_TRAILER)]
+                != _SEGMENT_TRAILER):
+            raise CorruptSegmentError('segment truncated (size/trailer '
+                                      'mismatch)')
+        view = memoryview(mapping)
+        return kind, [view[lo:lo + n] for lo, n in spans], mapping
+    except CorruptSegmentError:
+        mapping.close()
+        raise
+    except (struct.error, ValueError, OverflowError) as e:
+        mapping.close()
+        raise CorruptSegmentError(str(e))
+
+
+def _serialize_payload(value) -> Tuple[int, List]:
+    """``value -> (kind, frames)``. ``pa.Table`` uses the Arrow IPC stream
+    (zero-copy re-open); everything else uses pickle protocol 5 with large
+    buffers out-of-band (zero-copy ndarray views on attach)."""
+    import pyarrow as pa
+    if isinstance(value, pa.Table):
+        from petastorm_tpu.workers.serializers import ArrowTableSerializer
+        return KIND_ARROW_IPC, [ArrowTableSerializer().serialize(value)]
+    frames: List = [None]
+
+    def keep_out_of_band(pickle_buffer):
+        try:
+            raw = pickle_buffer.raw()
+        except BufferError:          # non-contiguous exporter: in-band
+            return True
+        if raw.nbytes < _OOB_THRESHOLD_BYTES:
+            return True
+        frames.append(raw)
+        return False
+
+    frames[0] = pickle.dumps(value, protocol=5,
+                             buffer_callback=keep_out_of_band)
+    return KIND_PICKLE5, frames
+
+
+def _deserialize_payload(kind: int, frames: List[memoryview]):
+    if kind == KIND_ARROW_IPC:
+        import pyarrow as pa
+        with pa.ipc.open_stream(pa.py_buffer(frames[0])) as reader:
+            return reader.read_all()
+    if kind == KIND_PICKLE5:
+        return pickle.loads(frames[0], buffers=frames[1:])
+    raise CorruptSegmentError('unknown segment kind {}'.format(kind))
+
+
+# -- one tier ------------------------------------------------------------------
+
+class _SegmentStore:
+    """One directory of segment files with approximate-LRU byte-bounded
+    eviction (the :class:`~petastorm_tpu.cache.LocalDiskCache` accounting
+    discipline: a running per-process total, re-seeded by a scan whenever it
+    crosses the limit or goes stale). Evictions either spill into
+    ``spill_store`` or unlink. Pinned segments (see ``_PinRegistry``) are
+    skipped unless their pinning pid is dead."""
+
+    def __init__(self, root: str, size_limit_bytes: int, pins: '_PinRegistry',
+                 spill_store: Optional['_SegmentStore'] = None):
+        self.root = root
+        self._size_limit = size_limit_bytes
+        self._pins = pins
+        self._spill = spill_store
+        self._lock = threading.Lock()
+        self._approx_total: Optional[int] = None
+        self.evictions = 0
+        self.spills = 0
+        os.makedirs(root, exist_ok=True)
+
+    def path_for(self, digest: str) -> str:
+        return os.path.join(self.root, digest + '.seg')
+
+    def contains(self, digest: str) -> bool:
+        return os.path.exists(self.path_for(digest))
+
+    def _entries(self):
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith('.seg'):
+                continue
+            full = os.path.join(self.root, name)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            yield full, st.st_size, st.st_mtime
+
+    def size_bytes(self) -> int:
+        return sum(size for _, size, _ in self._entries())
+
+    def approx_size_bytes(self) -> int:
+        with self._lock:
+            if self._approx_total is None:
+                self._approx_total = self.size_bytes()
+            return max(0, self._approx_total)
+
+    def put(self, digest: str, kind: int, frames: List) -> None:
+        path = self.path_for(digest)
+        incoming = sum(memoryview(f).nbytes for f in frames) + _HEADER.size
+        try:
+            replaced = os.stat(path).st_size
+        except OSError:
+            replaced = 0
+        self._evict_if_needed(incoming - replaced)
+        size = write_segment(path, kind, frames)
+        with self._lock:
+            if self._approx_total is not None:
+                # the pre-charge above used the frame-byte estimate; correct
+                # to the actual on-disk size (padding, frame table)
+                self._approx_total += size - incoming
+        os.utime(path, None)
+
+    def put_file(self, digest: str, src_path: str) -> None:
+        """Publish an existing *validated* segment file's bytes (tier
+        promotion / spill). Copies — the tiers usually live on different
+        filesystems, so a rename cannot move between them."""
+        try:
+            size = os.stat(src_path).st_size
+        except OSError:
+            return
+        path = self.path_for(digest)
+        try:
+            replaced = os.stat(path).st_size
+        except OSError:
+            replaced = 0
+        # charge only the delta when re-spilling over an identical existing
+        # segment, or the running total inflates on every spill cycle
+        self._evict_if_needed(size - replaced)
+        fd, tmp = tempfile.mkstemp(dir=self.root, suffix='.tmp')
+        try:
+            with os.fdopen(fd, 'wb') as out, open(src_path, 'rb') as src:
+                while True:
+                    chunk = src.read(1 << 20)
+                    if not chunk:
+                        break
+                    out.write(chunk)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    def touch(self, digest: str) -> None:
+        try:
+            os.utime(self.path_for(digest), None)
+        except OSError:
+            pass
+
+    def drop(self, digest: str) -> None:
+        path = self.path_for(digest)
+        try:
+            size = os.stat(path).st_size
+            os.remove(path)
+        except OSError:
+            return
+        with self._lock:
+            if self._approx_total is not None:
+                self._approx_total -= size
+
+    def _evict_if_needed(self, incoming_bytes: int) -> None:
+        evict_plan = None
+        with self._lock:
+            if self._approx_total is None:
+                self._approx_total = self.size_bytes()
+            self._approx_total += incoming_bytes
+            if self._approx_total < 0:
+                # per-process running totals drift under concurrent
+                # multi-process writers; a negative total is proof of
+                # staleness — re-seed from a scan
+                self._approx_total = self.size_bytes() + max(0, incoming_bytes)
+            if self._approx_total <= self._size_limit:
+                return
+            entries = list(self._entries())
+            total = sum(size for _, size, _ in entries) + max(0, incoming_bytes)
+            self._approx_total = total
+            if total <= self._size_limit:
+                return
+            evict_plan = (entries, total)
+        entries, total = evict_plan
+        for full, size, _mtime in sorted(entries, key=lambda e: e[2]):
+            if total <= self._size_limit:
+                break
+            digest = os.path.basename(full)[:-len('.seg')]
+            if self._pins.is_pinned(digest):
+                continue
+            if self._spill is not None:
+                try:
+                    self._spill.put_file(digest, full)
+                    self.spills += 1
+                except OSError as e:
+                    logger.warning('shared cache spill failed: %s', e)
+            try:
+                os.remove(full)
+            except OSError:
+                continue
+            self.evictions += 1
+            total -= size
+        with self._lock:
+            self._approx_total = total
+
+
+# -- pins ----------------------------------------------------------------------
+
+class _PinRegistry:
+    """Cross-process advisory pins: one ``<digest>.<pid>.<token>.pin`` file
+    per attachment. Eviction consults :meth:`is_pinned`; pins whose pid is
+    dead are expired (removed) on sight — a crashed reader cannot pin a
+    segment forever."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def pin(self, digest: str, token: str) -> str:
+        path = os.path.join(self.root, '{}.{}.{}.pin'.format(
+            digest, os.getpid(), token))
+        try:
+            with open(path, 'w'):
+                pass
+        except OSError as e:
+            logger.warning('failed to pin shared-cache segment: %s', e)
+        return path
+
+    @staticmethod
+    def unpin(pin_path: str) -> None:
+        try:
+            os.remove(pin_path)
+        except OSError:
+            pass
+
+    def is_pinned(self, digest: str) -> bool:
+        prefix = digest + '.'
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return False
+        for name in names:
+            if not (name.startswith(prefix) and name.endswith('.pin')):
+                continue
+            try:
+                pid = int(name[len(prefix):].split('.', 1)[0])
+            except ValueError:
+                pid = -1
+            if _pid_alive(pid):
+                return True
+            # dead-reader pin expiry: reclaim the marker so it never again
+            # costs a liveness probe
+            try:
+                os.remove(os.path.join(self.root, name))
+            except OSError:
+                pass
+        return False
+
+
+# -- the cache -----------------------------------------------------------------
+
+class _Attachment:
+    __slots__ = ('mapping', 'pin_path')
+
+    def __init__(self, mapping, pin_path):
+        self.mapping = mapping
+        self.pin_path = pin_path
+
+
+class SharedRowGroupCache(CacheBase):
+    """Tiered host-wide cache of decoded row-group payloads.
+
+    :param path: host-shared root directory. Tier-1 segments, pins, locks
+        and counters live here; tier 0 lives in ``/dev/shm`` keyed by a
+        digest of this path (every cache built on the same ``path`` attaches
+        to the same tiers), or under ``path`` when no shm mount exists.
+    :param size_limit_bytes: tier-1 (disk) byte budget.
+    :param mem_size_limit_bytes: tier-0 (shared-memory) byte budget;
+        defaults to ``min(size_limit_bytes, 1 GiB)``.
+    :param mem_dir: explicit tier-0 directory (overrides the shm default;
+        tests point it at tmpfs-free scratch).
+    :param attach_limit: how many attached segments this instance keeps
+        pinned (LRU); older attachments unpin but their mappings survive as
+        long as returned arrays reference them.
+    :param lock_timeout_s: single-flight wait bound. A missing reader waits
+        this long for another process's in-flight fill before decoding
+        locally (correctness over decode-once).
+    :param cleanup: remove this cache's directories on :meth:`cleanup`.
+
+    Instances are picklable (process-pool ``worker_args``): the unpickled
+    copy re-attaches to the same tiers with fresh local state.
+    """
+
+    def __init__(self, path: str, size_limit_bytes: int,
+                 mem_size_limit_bytes: Optional[int] = None,
+                 mem_dir: Optional[str] = None,
+                 attach_limit: int = _DEFAULT_ATTACH_LIMIT,
+                 lock_timeout_s: float = 30.0,
+                 cleanup: bool = False):
+        if not path:
+            raise ValueError("cache_type='shared' needs a cache_location "
+                             'directory shared by every attaching reader')
+        if size_limit_bytes <= 0:
+            raise ValueError('size_limit_bytes must be positive, got '
+                             '{!r}'.format(size_limit_bytes))
+        self._path = os.path.abspath(path)
+        self._size_limit = int(size_limit_bytes)
+        self._mem_limit = int(mem_size_limit_bytes
+                              or min(self._size_limit,
+                                     _DEFAULT_MEM_LIMIT_BYTES))
+        self._mem_dir_override = mem_dir
+        self._attach_limit = max(1, attach_limit)
+        self._lock_timeout_s = lock_timeout_s
+        self._cleanup_on_exit = cleanup
+        self._init_runtime()
+
+    def _init_runtime(self) -> None:
+        self._instance_token = uuid.uuid4().hex[:8]
+        self._lock = threading.Lock()
+        self._closed = False
+        mem_dir = self._mem_dir_override or self._default_mem_dir(self._path)
+        os.makedirs(self._path, exist_ok=True)
+        self._pins = _PinRegistry(os.path.join(self._path, 'pins'))
+        self._disk = _SegmentStore(os.path.join(self._path, 'disk'),
+                                   self._size_limit, self._pins)
+        self._mem = _SegmentStore(mem_dir, self._mem_limit, self._pins,
+                                  spill_store=self._disk)
+        self._locks_dir = os.path.join(self._path, 'locks')
+        self._counters_dir = os.path.join(self._path, 'counters')
+        os.makedirs(self._locks_dir, exist_ok=True)
+        os.makedirs(self._counters_dir, exist_ok=True)
+        self._attached: 'OrderedDict[str, _Attachment]' = OrderedDict()
+        self._events = {'shared_hits': 0, 'shared_misses': 0,
+                        'shared_evictions': 0}
+        self._totals = {'hits': 0, 'misses': 0, 'fills': 0, 'evictions': 0,
+                        'spills': 0, 'corrupt_dropped': 0, 'lock_waits': 0,
+                        'lock_steals': 0}
+        self._events_since_flush = 0
+        self._counter_path = os.path.join(
+            self._counters_dir,
+            '{}-{}.json'.format(os.getpid(), self._instance_token))
+        self._sweep_stale_counters()
+
+    def _sweep_stale_counters(self) -> None:
+        """Reclaim counter files of long-dead processes so a production
+        cache root does not accumulate one file per reader forever (the
+        pin registry's dead-pid expiry, applied to counters — but with a
+        TTL, so a just-finished fleet stays summable)."""
+        now = time.time()
+        try:
+            names = os.listdir(self._counters_dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith('.json'):
+                continue
+            try:
+                pid = int(name.split('-', 1)[0])
+            except ValueError:
+                pid = -1
+            full = os.path.join(self._counters_dir, name)
+            try:
+                old = (now - os.stat(full).st_mtime) > _COUNTER_TTL_S
+            except OSError:
+                continue
+            if old and not _pid_alive(pid):
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass
+
+    @staticmethod
+    def _default_mem_dir(path: str) -> str:
+        digest = hashlib.md5(path.encode('utf-8')).hexdigest()[:12]
+        if os.path.isdir('/dev/shm'):
+            return os.path.join('/dev/shm', 'petastorm-tpu-' + digest)
+        return os.path.join(path, 'mem')
+
+    # pickling: worker_args cross the process-pool boundary; runtime state
+    # (mmaps, pins, counters) is per-process and rebuilt on arrival
+    def __getstate__(self):
+        return {'path': self._path, 'size_limit': self._size_limit,
+                'mem_limit': self._mem_limit,
+                'mem_dir': self._mem_dir_override,
+                'attach_limit': self._attach_limit,
+                'lock_timeout_s': self._lock_timeout_s,
+                'cleanup': self._cleanup_on_exit}
+
+    def __setstate__(self, state):
+        self._path = state['path']
+        self._size_limit = state['size_limit']
+        self._mem_limit = state['mem_limit']
+        self._mem_dir_override = state['mem_dir']
+        self._attach_limit = state['attach_limit']
+        self._lock_timeout_s = state['lock_timeout_s']
+        self._cleanup_on_exit = state['cleanup']
+        self._init_runtime()
+
+    # -- lookup ----------------------------------------------------------------
+
+    @staticmethod
+    def _digest(key: str) -> str:
+        return hashlib.md5(key.encode('utf-8')).hexdigest()
+
+    def contains(self, key: str) -> bool:
+        """Whether ``key`` is currently served by tier 0 or tier 1 (no
+        attachment, no locks — the readahead planner's peek)."""
+        digest = self._digest(key)
+        return self._mem.contains(digest) or self._disk.contains(digest)
+
+    def _try_attach(self, digest: str):
+        """``(payload,)`` on a tier hit, ``None`` on a miss. Promotes tier-1
+        hits into tier 0; drops (and mischarges as a miss) corrupt
+        segments."""
+        for store, promote in ((self._mem, False), (self._disk, True)):
+            path = store.path_for(digest)
+            if not os.path.exists(path):
+                continue
+            try:
+                kind, frames, mapping = read_segment(path)
+            except OSError:
+                continue
+            except CorruptSegmentError:
+                # truncated/torn segments are dropped, never served
+                store.drop(digest)
+                with self._lock:
+                    self._totals['corrupt_dropped'] += 1
+                continue
+            try:
+                payload = _deserialize_payload(kind, frames)
+            except CorruptSegmentError:
+                mapping.close()
+                store.drop(digest)
+                with self._lock:
+                    self._totals['corrupt_dropped'] += 1
+                continue
+            if promote:
+                try:
+                    self._mem.put_file(digest, path)
+                except OSError:
+                    pass
+                else:
+                    # the segment now lives in tier 0; keeping the disk
+                    # copy too would double-count it against both budgets
+                    # (tier-0 eviction re-spills it when the time comes)
+                    store.drop(digest)
+            else:
+                store.touch(digest)
+            self._register_attachment(digest, mapping)
+            return (payload,)
+        return None
+
+    def _register_attachment(self, digest: str, mapping) -> None:
+        pin_path = self._pins.pin(digest, self._instance_token)
+        with self._lock:
+            old = self._attached.pop(digest, None)
+            self._attached[digest] = _Attachment(mapping, pin_path)
+            dropped = []
+            while len(self._attached) > self._attach_limit:
+                dropped.append(self._attached.popitem(last=False)[1])
+        if old is not None:
+            dropped.append(old)
+        for att in dropped:
+            # unpin only; the mapping object stays alive for as long as any
+            # payload view references it (refcounted via memoryview.obj)
+            self._pins.unpin(att.pin_path)
+
+    # -- single-flight fill ----------------------------------------------------
+
+    def _lock_path(self, digest: str) -> str:
+        return os.path.join(self._locks_dir, digest + '.lock')
+
+    @property
+    def _lock_id(self) -> str:
+        return '{}:{}'.format(os.getpid(), self._instance_token)
+
+    def _try_lock(self, digest: str) -> bool:
+        # link() an already-complete file into place: the lock is atomic AND
+        # its holder id is readable from the first instant it exists — an
+        # O_CREAT|O_EXCL + write pair has a window where a concurrent
+        # staleness probe reads an empty file and wrongly steals. The temp
+        # name is unique PER CALL: thread-pool workers share one instance,
+        # so an instance-scoped name would let two same-key missers race on
+        # one temp file (one thread's cleanup making the other's link fail
+        # with ENOENT, escaping into the read path).
+        path = self._lock_path(digest)
+        tmp = '{}.{}.{}'.format(path, self._lock_id, uuid.uuid4().hex[:8])
+        try:
+            with open(tmp, 'w') as f:
+                f.write(self._lock_id)
+            try:
+                os.link(tmp, path)
+            except OSError as e:
+                if e.errno in (errno.EEXIST, errno.ENOENT):
+                    return False     # lost the race (ENOENT: tmp dir raced)
+                raise
+            return True
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def _unlock(self, digest: str) -> None:
+        # only remove OUR lock: a holder that overran lock_timeout_s may
+        # have been stolen from, and blindly unlinking would release the
+        # thief's fresh lock (best-effort — the read+unlink pair is not
+        # atomic, but the residual window needs a second overrun inside it)
+        path = self._lock_path(digest)
+        try:
+            with open(path) as f:
+                if f.read().strip() != self._lock_id:
+                    return
+            os.remove(path)
+        except OSError:
+            pass
+
+    @staticmethod
+    def _parse_lock_holder(content: str) -> int:
+        try:
+            return int(content.strip().split(':', 1)[0] or -1)
+        except ValueError:
+            return -1
+
+    def _read_lock_state(self, path: str):
+        """``(holder_pid, age_s)`` of a lock file, or ``None`` when it
+        vanished/is unreadable."""
+        try:
+            st = os.stat(path)
+            with open(path) as f:
+                holder = self._parse_lock_holder(f.read())
+        except OSError:
+            return None
+        return holder, time.time() - st.st_mtime
+
+    def _lock_stale(self, digest: str) -> bool:
+        state = self._read_lock_state(self._lock_path(digest))
+        if state is None:
+            return False      # lock vanished: not stale
+        holder, age = state
+        if holder < 0:
+            # unparsable holder: only age can prove staleness
+            return age > self._lock_timeout_s
+        if not _pid_alive(holder):
+            return True
+        return age > self._lock_timeout_s
+
+    def _steal_lock(self, digest: str) -> bool:
+        """Claim-then-validate steal of a stale lock. Renaming the lock to
+        a unique claim name is atomic, so of N waiters that all observed
+        the same stale lock exactly ONE wins the claim — unconditional
+        unlink here would let one stealer delete another stealer's freshly
+        re-acquired lock and re-admit the duplicate decode the lock exists
+        to prevent. The claimed file is re-validated before being
+        discarded; a lock that turned out live is restored (unless a new
+        one already took its place)."""
+        path = self._lock_path(digest)
+        claim = '{}.claim.{}.{}'.format(path, self._lock_id,
+                                        uuid.uuid4().hex[:8])
+        try:
+            os.rename(path, claim)
+        except OSError:
+            return False      # someone else claimed it / it vanished
+        state = self._read_lock_state(claim)
+        stale = True
+        if state is not None:
+            holder, age = state
+            if age <= self._lock_timeout_s:
+                stale = holder < 0 or not _pid_alive(holder)
+        if not stale:
+            # mis-steal (the holder renewed between observation and claim):
+            # put it back unless a new lock already exists
+            try:
+                os.link(claim, path)
+            except OSError:
+                pass
+        try:
+            os.remove(claim)
+        except OSError:
+            pass
+        if stale:
+            with self._lock:
+                self._totals['lock_steals'] += 1
+        return stale
+
+    def _wait_for_fill(self, digest: str):
+        """Another process holds the fill lock: wait for its segment (or a
+        stale lock to steal). Returns an attached ``(payload,)`` or ``None``
+        (caller decodes locally)."""
+        deadline = time.monotonic() + self._lock_timeout_s
+        delay = 0.002
+        with self._lock:
+            self._totals['lock_waits'] += 1
+        while time.monotonic() < deadline:
+            time.sleep(delay)
+            # capped backoff: a decode takes tens of ms, so a coarse poll
+            # would tax every waiter ~a poll period per awaited fill
+            delay = min(delay * 2, 0.02)
+            attached = self._try_attach(digest)
+            if attached is not None:
+                return attached
+            if not os.path.exists(self._lock_path(digest)):
+                # filler finished (or died post-unlock) without a segment
+                # we can see yet: one last attach attempt, then fill locally
+                return self._try_attach(digest)
+            if self._lock_stale(digest) and self._steal_lock(digest):
+                return None
+        return None
+
+    # -- CacheBase -------------------------------------------------------------
+
+    def get(self, key: str, fill_cache_func):
+        digest = self._digest(key)
+        attached = self._try_attach(digest)
+        if attached is not None:
+            self._record(hit=True)
+            return attached[0]
+        got_lock = self._try_lock(digest)
+        if not got_lock:
+            if self._lock_stale(digest) and self._steal_lock(digest):
+                got_lock = self._try_lock(digest)
+            if not got_lock:
+                attached = self._wait_for_fill(digest)
+                if attached is not None:
+                    self._record(hit=True)
+                    return attached[0]
+                got_lock = self._try_lock(digest)
+        try:
+            # re-check under the lock: the previous holder may have
+            # published between our miss and our acquisition
+            attached = self._try_attach(digest)
+            if attached is not None:
+                self._record(hit=True)
+                return attached[0]
+            value = fill_cache_func()
+            self._record(hit=False)
+            try:
+                kind, frames = _serialize_payload(value)
+                self._mem.put(digest, kind, frames)
+                with self._lock:
+                    self._totals['fills'] += 1
+            except (OSError, pickle.PicklingError, TypeError,
+                    ValueError) as e:
+                # cache publication failures must never fail the read path
+                logger.warning('failed to publish shared-cache segment: %s',
+                               e)
+            return value
+        finally:
+            if got_lock:
+                self._unlock(digest)
+
+    # -- telemetry -------------------------------------------------------------
+
+    def _record(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self._events['shared_hits'] += 1
+                self._totals['hits'] += 1
+            else:
+                self._events['shared_misses'] += 1
+                self._totals['misses'] += 1
+            evictions = self._mem.evictions + self._disk.evictions
+            new_evictions = evictions - self._totals['evictions']
+            if new_evictions:
+                self._events['shared_evictions'] += new_evictions
+                self._totals['evictions'] = evictions
+            self._totals['spills'] = self._mem.spills
+            self._events_since_flush += 1
+            flush = self._events_since_flush >= _COUNTER_FLUSH_EVERY
+            if flush:
+                self._events_since_flush = 0
+        if flush:
+            self._flush_counters()
+
+    def take_events(self) -> Dict[str, int]:
+        """Drain the ``ReaderStats``-shaped counter deltas accumulated since
+        the last drain (``shared_hits``/``shared_misses``/
+        ``shared_evictions``); the owning worker records them after each
+        cache access."""
+        with self._lock:
+            events = dict(self._events)
+            for name in self._events:
+                self._events[name] = 0
+        return events
+
+    def occupancy_bytes(self) -> int:
+        """Approximate bytes resident across both tiers (running totals; no
+        directory scan on the hot path)."""
+        return self._mem.approx_size_bytes() + self._disk.approx_size_bytes()
+
+    def size_bytes(self) -> int:
+        """Exact resident bytes (directory scan; diagnostics/tests only)."""
+        return self._mem.size_bytes() + self._disk.size_bytes()
+
+    def counters(self) -> Dict[str, int]:
+        """This instance's lifetime totals."""
+        with self._lock:
+            return dict(self._totals)
+
+    def _flush_counters(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            payload = dict(self._totals, pid=os.getpid())
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self._counters_dir, suffix='.tmp')
+            with os.fdopen(fd, 'w') as f:
+                json.dump(payload, f)
+            os.replace(tmp, self._counter_path)
+        except OSError:
+            pass
+
+    @staticmethod
+    def global_counters(path: str) -> Dict[str, int]:
+        """Host-wide totals summed over every attaching process's flushed
+        counter file — how the acceptance benchmark proves "decoded once"
+        across K reader processes."""
+        totals: Dict[str, int] = {}
+        counters_dir = os.path.join(os.path.abspath(path), 'counters')
+        try:
+            names = os.listdir(counters_dir)
+        except OSError:
+            return totals
+        for name in names:
+            if not name.endswith('.json'):
+                continue
+            try:
+                with open(os.path.join(counters_dir, name)) as f:
+                    blob = json.load(f)
+            except (OSError, ValueError):
+                continue
+            for k, v in blob.items():
+                if isinstance(v, int) and k != 'pid':
+                    totals[k] = totals.get(k, 0) + v
+        return totals
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def close(self) -> None:
+        """Flush counters and release this instance's pins. Idempotent; the
+        piece workers call it from ``shutdown()``. Attached mappings are NOT
+        force-closed — payload views own them refcounted."""
+        self._flush_counters()
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            attached, self._attached = self._attached, OrderedDict()
+        for att in attached.values():
+            self._pins.unpin(att.pin_path)
+
+    def cleanup(self):
+        self.close()
+        if not self._cleanup_on_exit:
+            return
+        import shutil
+        shutil.rmtree(self._mem.root, ignore_errors=True)
+        shutil.rmtree(self._path, ignore_errors=True)

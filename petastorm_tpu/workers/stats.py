@@ -48,10 +48,16 @@ COUNTERS = (
     'readahead_misses',  # row-group reads that went inline (not prefetched)
     'rows_quarantined',  # rows dropped under on_decode_error='skip'/'quarantine'
     'items_quarantined',  # quarantine/skip events (items or row batches)
+    'shared_hits',       # row groups served from the host-wide shared cache
+    'shared_misses',     # shared-cache lookups that fell through to io+decode
+    'shared_evictions',  # shared-cache segments evicted/spilled (this reader)
 )
 
 #: Occupancy gauges; each also keeps a ``<name>_max`` high-water mark.
-GAUGES = ('queue_depth', 'shuffle_buffer_depth', 'readahead_depth')
+#: ``shared_cache_bytes`` samples the host-wide tiered cache's approximate
+#: resident bytes (tier 0 + tier 1) as seen by this reader's workers.
+GAUGES = ('queue_depth', 'shuffle_buffer_depth', 'readahead_depth',
+          'shared_cache_bytes')
 
 #: Derived keys added to every snapshot (not accumulated directly).
 #: ``items_per_s``/``mb_per_s`` are rates over the snapshot window — the time

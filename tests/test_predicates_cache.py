@@ -97,6 +97,43 @@ class TestLocalDiskCache:
         import os
         assert not os.path.exists(str(tmp_path / 'c'))
 
+    def test_cleanup_leaves_no_renamed_residue(self, tmp_path):
+        # shard dirs are removed rename-first (atomic disappearance); the
+        # renamed '.removing' intermediates must not outlive cleanup()
+        import os
+        cache = LocalDiskCache(str(tmp_path / 'c'), 1 << 20, cleanup=True)
+        for i in range(20):
+            cache.get('k{}'.format(i), lambda i=i: i)
+        cache.cleanup()
+        assert not os.path.exists(str(tmp_path / 'c'))
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if '.removing.' in n]
+
+    def test_negative_drift_reseeds_from_scan(self, tmp_path):
+        # Multi-process writers drift the per-process running total; a
+        # concurrent overwrite can even drive it NEGATIVE (the other
+        # process's bytes were never added here but the replaced-size
+        # subtraction still applies). The next store must re-seed from a
+        # directory scan instead of comparing garbage against the limit.
+        cache = LocalDiskCache(str(tmp_path), size_limit_bytes=1 << 20)
+        cache.get('seed', lambda: np.arange(100))
+        cache._approx_total = -12345          # simulated cross-process drift
+        cache.get('k2', lambda: np.arange(100))
+        assert cache._approx_total >= 0
+        assert abs(cache._approx_total - cache.size_bytes()) < 1024
+
+    def test_stale_total_reseeds_periodically(self, tmp_path):
+        from petastorm_tpu.cache import RESEED_SCAN_EVERY
+        cache = LocalDiskCache(str(tmp_path), size_limit_bytes=1 << 20)
+        cache.get('seed', lambda: np.arange(100))
+        cache._approx_total = 10 ** 12        # wildly stale but positive
+        cache._stores_since_scan = RESEED_SCAN_EVERY
+        # a stale-but-positive total would otherwise trigger a pointless
+        # full eviction scan on every store once it exceeds the limit
+        cache.get('k2', lambda: np.arange(100))
+        assert cache._approx_total < 10 ** 9
+        assert abs(cache._approx_total - cache.size_bytes()) < 1024
+
     def test_null_cache(self):
         assert NullCache().get('k', lambda: 7) == 7
 

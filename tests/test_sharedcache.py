@@ -1,0 +1,521 @@
+"""Tests for the host-wide tiered shared row-group cache
+(``petastorm_tpu/sharedcache.py``; see docs/cache.md).
+
+Covers the concurrency/crash contracts the subsystem promises: concurrent
+attach across threads and processes, single-flight fills, size-bounded
+eviction that spills to the disk tier and respects live pins, dead-reader
+pin expiry (the killed-process pattern from tests/test_health.py /
+test_lineage.py applied to cache attachment), truncated-segment rejection,
+the ``PETASTORM_TPU_SHARED_CACHE=0`` kill switch, and the uniform
+``cache_type='shared'`` knob on every reader factory.
+"""
+
+import hashlib
+import multiprocessing
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.cache import NullCache
+from petastorm_tpu.sharedcache import (KIND_PICKLE5, CorruptSegmentError,
+                                       SharedRowGroupCache, _PinRegistry,
+                                       read_segment, shared_cache_enabled,
+                                       write_segment)
+
+
+def _mk(tmp_path, name='root', **kwargs):
+    kwargs.setdefault('mem_dir', str(tmp_path / (name + '_mem')))
+    return SharedRowGroupCache(str(tmp_path / name), 1 << 24, **kwargs)
+
+
+def _digest(key):
+    return hashlib.md5(key.encode()).hexdigest()
+
+
+def _blob(i, n=20_000):
+    return {'a': np.full(n, i, dtype=np.int64),
+            'meta': {'i': i, 's': 'label_{}'.format(i)}}
+
+
+# -- segment format ------------------------------------------------------------
+
+class TestSegmentFormat:
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / 's.seg')
+        frames = [b'meta', np.arange(1000, dtype=np.int64).tobytes()]
+        write_segment(path, KIND_PICKLE5, frames)
+        kind, views, _m = read_segment(path)
+        assert kind == KIND_PICKLE5
+        assert bytes(views[0]) == b'meta'
+        np.testing.assert_array_equal(
+            np.frombuffer(views[1], dtype=np.int64), np.arange(1000))
+
+    @pytest.mark.parametrize('cut', [0, 3, 40, -3])
+    def test_truncated_rejected(self, tmp_path, cut):
+        path = str(tmp_path / 's.seg')
+        write_segment(path, KIND_PICKLE5, [b'meta', b'x' * 4096])
+        data = open(path, 'rb').read()
+        with open(path, 'wb') as f:
+            f.write(data[:cut])
+        with pytest.raises(CorruptSegmentError):
+            read_segment(path)
+
+    def test_garbage_rejected(self, tmp_path):
+        path = str(tmp_path / 's.seg')
+        with open(path, 'wb') as f:
+            f.write(b'not a segment at all' * 10)
+        with pytest.raises(CorruptSegmentError):
+            read_segment(path)
+
+
+# -- basic cache behavior ------------------------------------------------------
+
+class TestSharedCache:
+    def test_miss_then_hit_and_zero_copy_readonly(self, tmp_path):
+        cache = _mk(tmp_path)
+        calls = {'n': 0}
+
+        def fill():
+            calls['n'] += 1
+            return _blob(7)
+
+        v1 = cache.get('k', fill)
+        v2 = cache.get('k', fill)
+        assert calls['n'] == 1
+        np.testing.assert_array_equal(v1['a'], v2['a'])
+        assert v2['meta'] == {'i': 7, 's': 'label_7'}
+        # attached large arrays are read-only views over the mapping
+        assert not v2['a'].flags.writeable
+
+    def test_cross_instance_attach(self, tmp_path):
+        a = _mk(tmp_path)
+        b = _mk(tmp_path)
+        a.get('k', lambda: _blob(1))
+        v = b.get('k', lambda: pytest.fail('second instance must attach'))
+        np.testing.assert_array_equal(v['a'], _blob(1)['a'])
+
+    def test_arrow_table_segments(self, tmp_path):
+        table = pa.table({'x': np.arange(500), 'y': ['s%d' % i
+                                                     for i in range(500)]})
+        a = _mk(tmp_path)
+        b = _mk(tmp_path)
+        a.get('t', lambda: table)
+        got = b.get('t', lambda: pytest.fail('must attach'))
+        assert got.equals(table)
+
+    def test_contains_and_events(self, tmp_path):
+        cache = _mk(tmp_path)
+        assert not cache.contains('k')
+        cache.get('k', lambda: _blob(0))
+        assert cache.contains('k')
+        cache.get('k', lambda: pytest.fail('hit expected'))
+        events = cache.take_events()
+        assert events['shared_misses'] == 1 and events['shared_hits'] == 1
+        assert cache.take_events()['shared_hits'] == 0   # drained
+        assert cache.occupancy_bytes() > 0
+
+    def test_truncated_segment_refilled_not_served(self, tmp_path):
+        cache = _mk(tmp_path)
+        cache.get('k', lambda: _blob(3))
+        seg = os.path.join(str(tmp_path / 'root_mem'),
+                           _digest('k') + '.seg')
+        data = open(seg, 'rb').read()
+        with open(seg, 'wb') as f:
+            f.write(data[:len(data) // 2])
+        fresh = _mk(tmp_path)
+        value = fresh.get('k', lambda: {'refilled': True})
+        assert value == {'refilled': True}
+        assert fresh.counters()['corrupt_dropped'] == 1
+
+    def test_pickles_to_worker_processes(self, tmp_path):
+        import pickle
+        cache = _mk(tmp_path)
+        cache.get('k', lambda: _blob(5))
+        clone = pickle.loads(pickle.dumps(cache))
+        v = clone.get('k', lambda: pytest.fail('clone must attach'))
+        np.testing.assert_array_equal(v['a'], _blob(5)['a'])
+        clone.close()
+
+    def test_close_is_idempotent_and_releases_pins(self, tmp_path):
+        cache = _mk(tmp_path)
+        cache.get('k', lambda: _blob(1))
+        cache.get('k', lambda: None)          # attach -> pin
+        pins_dir = str(tmp_path / 'root' / 'pins')
+        assert any(n.endswith('.pin') for n in os.listdir(pins_dir))
+        cache.close()
+        cache.close()
+        assert not any(n.endswith('.pin') for n in os.listdir(pins_dir))
+
+
+# -- eviction / pins -----------------------------------------------------------
+
+class TestEvictionAndPins:
+    def test_eviction_spills_to_disk_tier_and_promotes_back(self, tmp_path):
+        cache = _mk(tmp_path, mem_size_limit_bytes=400_000)
+        for i in range(8):
+            cache.get('k%d' % i, lambda i=i: _blob(i))
+        disk_dir = str(tmp_path / 'root' / 'disk')
+        spilled = [n for n in os.listdir(disk_dir) if n.endswith('.seg')]
+        assert spilled, 'mem-tier eviction must spill segments to disk'
+        # every key still served (disk tier), value-exact
+        for i in range(8):
+            v = cache.get('k%d' % i,
+                          lambda: pytest.fail('tiered lookup must hit'))
+            assert v['a'][0] == i
+
+    def test_eviction_under_pressure_skips_pinned_segment(self, tmp_path):
+        cache = _mk(tmp_path, mem_size_limit_bytes=400_000)
+        cache.get('pinned', lambda: _blob(0))
+        held = cache.get('pinned', lambda: None)   # attach -> live pin
+        for i in range(10):
+            cache.get('k%d' % i, lambda i=i: _blob(i))
+        mem_dir = str(tmp_path / 'root_mem')
+        assert os.path.exists(os.path.join(
+            mem_dir, _digest('pinned') + '.seg')), \
+            'a live-pinned segment must survive memory pressure'
+        assert cache.counters()['evictions'] > 0
+        assert held['a'][0] == 0   # the mapping stayed valid throughout
+
+    def test_dead_reader_pin_expires(self, tmp_path):
+        pins = _PinRegistry(str(tmp_path / 'pins'))
+        digest = _digest('k')
+        # a pid that is certainly dead: a spawned child that already exited
+        ctx = multiprocessing.get_context('spawn')
+        child = ctx.Process(target=_exit_immediately)
+        child.start()
+        dead_pid = child.pid
+        child.join()
+        marker = os.path.join(str(tmp_path / 'pins'),
+                              '{}.{}.deadbeef.pin'.format(digest, dead_pid))
+        with open(marker, 'w'):
+            pass
+        assert not pins.is_pinned(digest)
+        assert not os.path.exists(marker), 'dead pins are reclaimed on sight'
+
+    def test_killed_reader_process_pins_expire(self, tmp_path):
+        """The killed-worker pattern: a reader process attaches (pins) and
+        dies without cleanup; its pins must not block eviction."""
+        ctx = multiprocessing.get_context('spawn')
+        child = ctx.Process(target=_attach_and_die,
+                            args=(str(tmp_path),), daemon=True)
+        child.start()
+        child.join(timeout=120)
+        assert child.exitcode == 17   # os._exit(17): no cleanup ran
+        pins_dir = str(tmp_path / 'root' / 'pins')
+        leaked = [n for n in os.listdir(pins_dir) if n.endswith('.pin')]
+        assert leaked, 'the dead child must have leaked a pin file'
+        pins = _PinRegistry(pins_dir)
+        assert not pins.is_pinned(_digest('k'))
+
+    def test_eviction_counts_surface_in_events(self, tmp_path):
+        cache = _mk(tmp_path, mem_size_limit_bytes=300_000)
+        for i in range(8):
+            cache.get('k%d' % i, lambda i=i: _blob(i))
+        events = cache.take_events()
+        assert events['shared_evictions'] > 0
+
+
+# -- single-flight -------------------------------------------------------------
+
+class TestSingleFlight:
+    def test_concurrent_fill_decodes_once(self, tmp_path):
+        a = _mk(tmp_path)
+        b = _mk(tmp_path)
+        calls = {'n': 0}
+        lock = threading.Lock()
+
+        def slow_fill():
+            with lock:
+                calls['n'] += 1
+            time.sleep(0.25)
+            return _blob(9)
+
+        results = [None, None]
+
+        def run(i, inst):
+            results[i] = inst.get('k', slow_fill)
+
+        t1 = threading.Thread(target=run, args=(0, a))
+        t2 = threading.Thread(target=run, args=(1, b))
+        t1.start()
+        time.sleep(0.05)
+        t2.start()
+        t1.join()
+        t2.join()
+        assert calls['n'] == 1
+        np.testing.assert_array_equal(results[0]['a'], results[1]['a'])
+        assert b.counters()['lock_waits'] + a.counters()['lock_waits'] == 1
+
+    def test_same_instance_concurrent_misses_one_fill_no_error(self,
+                                                               tmp_path):
+        """Thread-pool workers share ONE cache instance: N concurrent
+        same-key misses must produce one fill and zero escaping errors
+        (an instance-scoped lock temp name would let one thread's cleanup
+        break another's acquisition)."""
+        cache = _mk(tmp_path)
+        calls = {'n': 0}
+        lock = threading.Lock()
+        errors = []
+
+        def slow_fill():
+            with lock:
+                calls['n'] += 1
+            time.sleep(0.15)
+            return _blob(4)
+
+        def run():
+            try:
+                cache.get('k', slow_fill)
+            except BaseException as e:  # noqa: BLE001 - recorded for assert
+                errors.append(e)
+
+        threads = [threading.Thread(target=run) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert calls['n'] == 1
+
+    def test_promotion_drops_disk_copy(self, tmp_path):
+        cache = _mk(tmp_path, mem_size_limit_bytes=400_000)
+        for i in range(8):
+            cache.get('k%d' % i, lambda i=i: _blob(i))
+        disk_dir = str(tmp_path / 'root' / 'disk')
+        spilled = {n for n in os.listdir(disk_dir) if n.endswith('.seg')}
+        assert spilled
+        digest = next(iter(spilled))[:-len('.seg')]
+        key = next('k%d' % i for i in range(8)
+                   if _digest('k%d' % i) == digest)
+        cache.get(key, lambda: pytest.fail('disk-tier hit expected'))
+        # promoted back to tier 0: the disk copy must not stay resident
+        # against the disk budget too
+        assert not os.path.exists(os.path.join(disk_dir, digest + '.seg'))
+        mem_dir = str(tmp_path / 'root_mem')
+        assert os.path.exists(os.path.join(mem_dir, digest + '.seg'))
+
+    def test_stale_lock_from_dead_process_is_stolen(self, tmp_path):
+        cache = _mk(tmp_path)
+        ctx = multiprocessing.get_context('spawn')
+        child = ctx.Process(target=_exit_immediately)
+        child.start()
+        dead_pid = child.pid
+        child.join()
+        lock_path = os.path.join(str(tmp_path / 'root'), 'locks',
+                                 _digest('k') + '.lock')
+        with open(lock_path, 'w') as f:
+            f.write(str(dead_pid))
+        start = time.perf_counter()
+        value = cache.get('k', lambda: _blob(2))
+        assert time.perf_counter() - start < 5.0
+        assert value['a'][0] == 2
+        assert cache.counters()['lock_steals'] == 1
+
+
+# -- reader integration --------------------------------------------------------
+
+def _image_store(tmp_path, rows=32, rows_per_file=8):
+    from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema('Img', [
+        UnischemaField('idx', np.int64, (), ScalarCodec(), False),
+        UnischemaField('image', np.uint8, (16, 16),
+                       CompressedImageCodec('png'), False)])
+    url = 'file://' + str(tmp_path / 'ds')
+    rng = np.random.default_rng(0)
+    with materialize_dataset(url, schema, rows_per_file=rows_per_file) as w:
+        w.write_rows({'idx': np.int64(i),
+                      'image': rng.integers(0, 255, (16, 16), dtype=np.uint8)}
+                     for i in range(rows))
+    return url
+
+
+def _shared_kwargs(tmp_path):
+    return dict(cache_type='shared',
+                cache_location=str(tmp_path / 'cache'),
+                cache_size_limit=1 << 26,
+                cache_extra_settings={'mem_dir': str(tmp_path / 'mem')})
+
+
+class TestReaderIntegration:
+    def test_all_three_factories_accept_shared(self, tmp_path):
+        from petastorm_tpu import (make_batch_reader, make_columnar_reader,
+                                   make_reader)
+        url = _image_store(tmp_path)
+        kwargs = dict(shuffle_row_groups=False, **_shared_kwargs(tmp_path))
+        with make_columnar_reader(url, num_epochs=2,
+                                  reader_pool_type='dummy', **kwargs) as r:
+            assert sum(len(b.idx) for b in r) == 64
+            diag = r.diagnostics
+        assert diag['shared_hits'] > 0 and diag['shared_misses'] > 0
+        assert diag['shared_cache_bytes'] > 0
+        with make_reader(url, num_epochs=1, reader_pool_type='dummy',
+                         **kwargs) as r:
+            assert len(list(r)) == 32
+        with make_batch_reader(url, num_epochs=1, reader_pool_type='dummy',
+                               **kwargs) as r:
+            assert sum(len(b.idx) for b in r) == 32
+
+    def test_hit_skips_io_and_decode_entirely(self, tmp_path, monkeypatch):
+        from petastorm_tpu import make_columnar_reader
+        url = _image_store(tmp_path)
+        kwargs = dict(shuffle_row_groups=False, reader_pool_type='dummy',
+                      **_shared_kwargs(tmp_path))
+        with make_columnar_reader(url, num_epochs=1, **kwargs) as r:
+            first = {int(i): img.copy()
+                     for b in r for i, img in zip(b.idx, b.image)}
+        import petastorm_tpu.codecs as codecs
+        from petastorm_tpu.readers import piece_worker
+
+        def boom(*a, **k):
+            raise AssertionError('decode/read ran on a fully cached epoch')
+        monkeypatch.setattr(codecs.CompressedImageCodec, 'make_cell_decoder',
+                            boom)
+        monkeypatch.setattr(piece_worker.ParquetPieceWorker,
+                            '_read_row_group', boom)
+        with make_columnar_reader(url, num_epochs=1, **kwargs) as r:
+            replay = {int(i): img.copy()
+                      for b in r for i, img in zip(b.idx, b.image)}
+        assert set(replay) == set(first)
+        for k in first:
+            np.testing.assert_array_equal(first[k], replay[k])
+
+    def test_process_pool_workers_attach(self, tmp_path):
+        from petastorm_tpu import make_columnar_reader
+        url = _image_store(tmp_path)
+        kwargs = dict(shuffle_row_groups=False, reader_pool_type='process',
+                      workers_count=2, **_shared_kwargs(tmp_path))
+        with make_columnar_reader(url, num_epochs=2, **kwargs) as r:
+            assert sum(len(b.idx) for b in r) == 64
+            diag = r.diagnostics
+        assert diag['shared_hits'] > 0
+
+    def test_multiprocess_readers_decode_once(self, tmp_path):
+        """Two concurrent reader PROCESSES over one store and one shared
+        tier: the host-wide counters must show each row group filled
+        exactly once."""
+        url = _image_store(tmp_path)
+        cache_root = str(tmp_path / 'cache')
+        ctx = multiprocessing.get_context('spawn')
+        queue = ctx.Queue()
+        procs = [ctx.Process(target=_read_all_child,
+                             args=(url, str(tmp_path), seed, queue),
+                             daemon=True) for seed in (1, 2)]
+        for p in procs:
+            p.start()
+        results = [queue.get(timeout=300) for _ in procs]
+        for p in procs:
+            p.join(timeout=60)
+        assert all(r == 32 for r in results), results
+        totals = SharedRowGroupCache.global_counters(cache_root)
+        n_groups = 4   # 32 rows, 8 per file/group
+        assert totals['fills'] == n_groups, totals
+        assert totals['hits'] == n_groups, totals   # second reader all-hits
+
+    def test_predicate_with_shared_cache_rejected(self, tmp_path):
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.predicates import in_lambda
+        url = _image_store(tmp_path)
+        with pytest.raises(RuntimeError, match='cache'):
+            make_reader(url, predicate=in_lambda(['idx'], lambda v: True),
+                        **_shared_kwargs(tmp_path))
+
+    def test_readahead_plans_only_shared_misses(self, tmp_path):
+        """Tier-2: with the shared cache attached, the readahead planner
+        prefetches cold keys (epoch 1) and plans nothing once the tier
+        holds them (epoch 2 = pure hits, no background reads)."""
+        from petastorm_tpu import make_columnar_reader
+        url = _image_store(tmp_path)
+        kwargs = dict(shuffle_row_groups=False, reader_pool_type='thread',
+                      workers_count=1, io_readahead=2,
+                      **_shared_kwargs(tmp_path))
+        with make_columnar_reader(url, num_epochs=1, **kwargs) as r:
+            assert sum(len(b.idx) for b in r) == 32
+            cold = r.diagnostics
+        assert cold['readahead_hits'] > 0
+        with make_columnar_reader(url, num_epochs=1, **kwargs) as r:
+            assert sum(len(b.idx) for b in r) == 32
+            warm = r.diagnostics
+        assert warm['shared_misses'] == 0
+        assert warm['readahead_hits'] == 0 and warm['readahead_misses'] == 0
+
+
+# -- knobs / kill switch -------------------------------------------------------
+
+class TestKnobs:
+    def test_make_cache_error_enumerates_types(self):
+        from petastorm_tpu.reader import _make_cache
+        with pytest.raises(ValueError) as e:
+            _make_cache('bogus', None, None, None, None)
+        for name in ('null', 'local-disk', 'shared'):
+            assert name in str(e.value)
+
+    def test_shared_needs_location_and_limit(self):
+        from petastorm_tpu.reader import _make_cache
+        with pytest.raises(ValueError, match='cache_location'):
+            _make_cache('shared', None, None, None, None)
+
+    def test_kill_switch_disables_attachment_entirely(self, tmp_path,
+                                                      monkeypatch):
+        from petastorm_tpu import make_columnar_reader
+        from petastorm_tpu.reader import _make_cache
+        monkeypatch.setenv('PETASTORM_TPU_SHARED_CACHE', '0')
+        assert not shared_cache_enabled()
+        assert isinstance(
+            _make_cache('shared', str(tmp_path / 'c'), 1 << 20, None, None),
+            NullCache)
+        url = _image_store(tmp_path)
+        loc = tmp_path / 'killed_cache'
+        with make_columnar_reader(url, num_epochs=1,
+                                  reader_pool_type='dummy',
+                                  shuffle_row_groups=False,
+                                  cache_type='shared',
+                                  cache_location=str(loc),
+                                  cache_size_limit=1 << 26) as r:
+            assert sum(len(b.idx) for b in r) == 32
+        assert not loc.exists(), \
+            'kill switch must prevent any file/attachment at the location'
+
+    def test_cli_accepts_cache_knobs(self):
+        from petastorm_tpu.benchmark.cli import build_parser
+        args = build_parser().parse_args(
+            ['file:///tmp/x', '--cache-type', 'shared', '--cache-location',
+             '/tmp/c', '--cache-size-limit', '1000000'])
+        assert args.cache_type == 'shared'
+        assert args.cache_location == '/tmp/c'
+        assert args.cache_size_limit == 1000000
+
+
+# -- spawn helpers (module-level: picklable) -----------------------------------
+
+def _exit_immediately():
+    os._exit(0)
+
+
+def _attach_and_die(tmp_path):
+    cache = SharedRowGroupCache(os.path.join(tmp_path, 'root'), 1 << 24,
+                                mem_dir=os.path.join(tmp_path, 'root_mem'))
+    cache.get('k', lambda: {'a': np.arange(1000)})
+    cache.get('k', lambda: None)       # attach -> pin
+    os._exit(17)                       # die WITHOUT close(): pins leak
+
+
+def _read_all_child(url, tmp_path, seed, queue):
+    try:
+        from petastorm_tpu import make_columnar_reader
+        kwargs = dict(cache_type='shared',
+                      cache_location=os.path.join(tmp_path, 'cache'),
+                      cache_size_limit=1 << 26,
+                      cache_extra_settings={
+                          'mem_dir': os.path.join(tmp_path, 'mem')})
+        with make_columnar_reader(url, num_epochs=1, seed=seed,
+                                  reader_pool_type='thread', workers_count=1,
+                                  **kwargs) as reader:
+            queue.put(sum(len(b.idx) for b in reader))
+    except BaseException as e:  # noqa: BLE001 - surfaced in the parent
+        queue.put(repr(e))
