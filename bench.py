@@ -1,6 +1,18 @@
 #!/usr/bin/env python
-"""Headline benchmark. Prints ONE JSON line:
-``{"metric", "value", "unit", "vs_baseline", "dispersion", "northstar"}``.
+"""Headline benchmark.
+
+Output contract (round-5 postmortem: the driver's tail capture lost
+``BENCH_r05.json``'s headline because this script printed one huge JSON
+line): the LAST stdout line is a **bounded compact summary** (< 4 KB,
+asserted by ``tests/test_profiler.py``) containing the headline value and
+every per-line rate; the FULL summary goes to ``--out`` (written atomically
+via the shared ``utils.atomic_write``) and to stderr. A normalized entry of
+each run is appended to the local ``PERF_TRAJECTORY.jsonl``
+(``ci/check_perf_regression.py`` reads it as non-gating context next to the
+committed ``BENCH_*.json`` trajectory).
+
+Full-summary keys: ``{"metric", "value", "unit", "vs_baseline",
+"dispersion", "roofline_bench", "northstar", ...}``.
 
 - Primary metric: reader throughput on the hello-world schema with the same
   reader configuration as the reference's tool (3 thread workers, python
@@ -62,7 +74,138 @@ def _ensure(path, marker, generate):
         generate()
 
 
-def main():
+def _store_roofline(url):
+    """Calibrated serial io+decode ceiling (samples/sec) for one bench
+    store, via the roofline profiler's micro-probes (cached per
+    host+dataset digest — see docs/profiling.md). This is the denominator
+    the decode-wall lines are judged against; ``None`` when probing fails
+    (a broken probe must not sink the whole bench)."""
+    try:
+        from petastorm_tpu import profiler
+        from petastorm_tpu.etl.dataset_metadata import (
+            infer_or_load_unischema, load_row_groups)
+        from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+        fs, path, _ = get_filesystem_and_path_or_paths(url)
+        pieces = load_row_groups(fs, path)
+        schema, _ = infer_or_load_unischema(fs, path)
+        cal = profiler.get_calibration(fs, path, pieces, schema, mode='auto')
+        ceilings = cal['ceilings']
+        # io+decode ONLY: this number is labeled as the serial io+decode
+        # ceiling, so the staging/serializer probes must not silently cap it
+        serial = profiler.predict_throughput(
+            {'io': ceilings.get('io'), 'decode': ceilings.get('decode')},
+            workers=1, cpu_count=1, io_overlap=False)
+        return {
+            'io_decode_ceiling_samples_per_sec': round(serial, 1)
+            if serial else None,
+            'decode_ceiling_samples_per_sec': ceilings.get('decode'),
+            'io_ceiling_samples_per_sec': ceilings.get('io'),
+            'cpu_count': cal.get('cpu_count'),
+        }
+    except Exception as e:  # noqa: BLE001 - report, never sink the bench
+        print('store roofline probe failed for {}: {!r}'.format(url, e),
+              file=sys.stderr)
+        return None
+
+
+def _with_roofline(line: dict, roofline) -> dict:
+    """Attach the store's measured ceiling and this line's %-of-ceiling —
+    the VERDICT.md ask: every decode-bound/cached samples/sec judged
+    against a measured number, not vibes. Cached lines legitimately exceed
+    100% (they skip the io+decode the ceiling measures)."""
+    out = dict(line)
+    if not roofline:
+        return out
+    ceiling = roofline.get('io_decode_ceiling_samples_per_sec')
+    sps = out.get('samples_per_sec')
+    out['roofline'] = dict(roofline)
+    if ceiling and sps:
+        out['roofline_pct'] = round(100.0 * sps / ceiling, 2)
+    return out
+
+
+def compact_summary(summary: dict, out_path=None) -> dict:
+    """The bounded stdout summary: headline + per-line rates, nothing
+    free-text. ``tests/test_profiler.py`` asserts the serialized form
+    stays far inside a 4 KB tail-capture window."""
+    northstar = summary.get('northstar') or {}
+    lines = {}
+    for name, line in northstar.items():
+        if not isinstance(line, dict):
+            continue
+        sps = line.get('samples_per_sec')
+        if sps is None:
+            continue
+        brief = {'sps': round(sps, 1)}
+        if line.get('overlap_pct') is not None:
+            brief['ov'] = line['overlap_pct']
+        if line.get('roofline_pct') is not None:
+            brief['roof'] = line['roofline_pct']
+        lines[name] = brief
+    dispersion = dict(summary.get('dispersion') or {})
+    dispersion.pop('protocol', None)
+    roofline_bench = summary.get('roofline_bench') or {}
+    compact = {
+        'metric': summary.get('metric'),
+        'value': summary.get('value'),
+        'statistic': summary.get('statistic'),
+        'unit': summary.get('unit'),
+        'vs_baseline': summary.get('vs_baseline'),
+        'dispersion': dispersion,
+        'platform': northstar.get('platform'),
+        'roofline': {
+            'binding_stage': (roofline_bench.get('roofline') or {})
+            .get('binding_stage'),
+            'pct': (roofline_bench.get('roofline') or {})
+            .get('roofline_pct'),
+            'measured_sps': roofline_bench.get('measured_samples_per_sec'),
+        },
+        'northstar': lines,
+        'out': out_path,
+    }
+    return compact
+
+
+def emit(summary: dict, out_path=None) -> None:
+    """Full summary -> stderr + atomic ``--out`` file + local trajectory
+    append; bounded compact summary -> the LAST stdout line (the only line
+    a tail capture needs)."""
+    print(json.dumps(summary, sort_keys=True), file=sys.stderr)
+    if out_path:
+        from petastorm_tpu.utils import atomic_write
+        atomic_write(out_path,
+                     lambda f: json.dump(summary, f, indent=2,
+                                         sort_keys=True))
+    try:
+        # load the gate by path (same pattern as check_bench_docs): a bare
+        # sys.path.insert would let ci/ module names shadow stdlib/package
+        # imports for the rest of the process
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            'check_perf_regression',
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         'ci', 'check_perf_regression.py'))
+        gate = sys.modules.get('check_perf_regression')
+        if gate is None:
+            gate = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(gate)
+        entries, _ = gate.normalize_artifact('bench.py', {'parsed': summary})
+        gate.append_entries(entries)
+    except Exception as e:  # noqa: BLE001 - trajectory append is best-effort
+        print('perf-trajectory append failed: {!r}'.format(e),
+              file=sys.stderr)
+    sys.stderr.flush()
+    print(json.dumps(compact_summary(summary, out_path), sort_keys=True))
+
+
+def main(argv=None):
+    import argparse
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument('--out', default=None, metavar='PATH',
+                        help='write the FULL summary JSON here atomically '
+                             '(stdout carries only the bounded compact '
+                             'summary line)')
+    args = parser.parse_args(argv)
     platform = _probe_platform()
 
     from petastorm_tpu.benchmark import northstar
@@ -141,6 +284,15 @@ def main():
     # per_reader detail is full-run/artifact material, not headline JSON
     shared_cache['shared'].pop('per_reader', None)
     shared_cache['local_disk_baseline'].pop('per_reader', None)
+
+    # -- roofline: calibrated ceilings + attribution on the mnist decode line
+    # Quick mode asserts binding-stage/monotonicity/model-replay; the
+    # headline roofline record lives in BENCH_r12.json from the full run.
+    from petastorm_tpu.benchmark.roofline import run_roofline_bench
+    roofline_bench = run_roofline_bench(quick=True)
+    # span-level detail is artifact material, not headline JSON
+    roofline_bench.pop('attribution', None)
+    roofline_bench.pop('probes', None)
 
     # -- north-star: train-step infeed overlap ------------------------------
     # Accelerator-scale configs for any non-CPU backend; dataset paths carry
@@ -266,6 +418,16 @@ def main():
             num_steps=8, image_size=96)
     columnar = northstar.run_columnar_read_bench(mnist_url)
 
+    # measured io+decode ceilings for the decode-wall stores: every
+    # decode-bound and cached line below records its %-of-ceiling so the
+    # next decode-wall PR is judged against a measured number (the jpeg
+    # hinted lines are excluded — DCT-scaled decode does strictly less
+    # work than the full-resolution decode the probe measures, so a % of
+    # that ceiling would mislead)
+    mnist_roofline = _store_roofline(mnist_url)
+    imagenet_roofline = _store_roofline(imagenet_url)
+    imagenet_rg8_roofline = _store_roofline(imagenet_rg8_url)
+
     # Internal consistency: decode-only throughput must upper-bound
     # decode+train on the same store. Checked per store and recorded in the
     # artifact itself so BENCH JSON is self-consistent without the docs.
@@ -294,16 +456,15 @@ def main():
     # r05: one-dispatch transfer protocols can print ~99% overlap here only
     # by collapsing throughput ~10x (transfer riding inside "compute"), so
     # this line keeps the throughput-optimal protocol and reports honestly.
-    cached_dict = imagenet_cached.as_dict()
+    cached_dict = _with_roofline(imagenet_cached.as_dict(),
+                                 imagenet_rg8_roofline)
     if imagenet.samples_per_sec:
         cached_dict['vs_decode_bound'] = round(
             imagenet_cached.samples_per_sec / imagenet.samples_per_sec, 1)
-    cached_dict['note'] = ('claim = samples/sec multiple over imagenet_train '
-                          '(cache skips decode+resize); overlap on this '
-                          '1-core host is bounded by per-byte host work, '
-                          'see docs/benchmarks.md')
+    # the claim/caveat prose lives in docs/benchmarks.md (keeping notes out
+    # of the artifact bounds the summary line — the r05 capture lesson)
 
-    print(json.dumps({
+    summary = {
         'metric': 'hello_world_reader_throughput',
         # the MEDIAN run: the honest central figure on a host with tens-of-
         # percent run variance (the throughput CLI's --runs mode headlines
@@ -320,22 +481,26 @@ def main():
         'trace_overhead': trace_overhead,
         'lineage_overhead': lineage_overhead,
         'shared_cache': shared_cache,
+        'roofline_bench': roofline_bench,
         'northstar': {
             'platform': platform,
-            'mnist_train': mnist.as_dict(),
-            'mnist_train_cached': mnist_cached.as_dict(),
+            'mnist_train': _with_roofline(mnist.as_dict(), mnist_roofline),
+            'mnist_train_cached': _with_roofline(mnist_cached.as_dict(),
+                                                 mnist_roofline),
             'transformer_train': lm.as_dict(),
             'transformer_train_ngram': lm_ngram.as_dict(),
             'transformer_train_ngram_indexed': lm_ngram_indexed.as_dict(),
-            'image_decode': img_decode,
-            'imagenet_train': imagenet.as_dict(),
+            'image_decode': _with_roofline(img_decode, imagenet_roofline),
+            'imagenet_train': _with_roofline(imagenet.as_dict(),
+                                             imagenet_roofline),
             'image_decode_jpeg_hinted': img_decode_jpeg,
             'imagenet_train_jpeg_hinted': imagenet_jpeg.as_dict(),
             'imagenet_train_cached': cached_dict,
-            'columnar_read': columnar,
+            'columnar_read': _with_roofline(columnar, mnist_roofline),
             'decode_train_consistency': consistency,
         },
-    }))
+    }
+    emit(summary, args.out)
 
 
 if __name__ == '__main__':

@@ -43,7 +43,7 @@ ANNOTATION = re.compile(
 #: annotated (MIN_ANNOTATIONS guards against the gate being emptied out)
 DEFAULT_DOCS = ('docs/benchmarks.md', 'docs/transport.md',
                 'docs/readahead.md', 'docs/tracing.md', 'docs/health.md',
-                'docs/lineage.md', 'docs/cache.md')
+                'docs/lineage.md', 'docs/cache.md', 'docs/profiling.md')
 MIN_ANNOTATIONS = 30
 
 #: Artifacts that MUST be quoted by at least one annotation across the
@@ -51,9 +51,41 @@ MIN_ANNOTATIONS = 30
 #: check (round-9 extension — BENCH_r09 must be referenced from the docs,
 #: and the earlier per-PR artifacts stay referenced too; round-10 adds
 #: BENCH_r10, the lineage-overhead record; round-11 adds BENCH_r11, the
-#: shared-cache decode-once record).
+#: shared-cache decode-once record; round-12 adds BENCH_r12, the roofline
+#: calibration + attribution record).
 REQUIRED_ARTIFACTS = ('BENCH_r06.json', 'BENCH_r07.json', 'BENCH_r08.json',
-                      'BENCH_r09.json', 'BENCH_r10.json', 'BENCH_r11.json')
+                      'BENCH_r09.json', 'BENCH_r10.json', 'BENCH_r11.json',
+                      'BENCH_r12.json')
+
+def check_artifacts_intact(root: str = ROOT):
+    """Reject any committed ``BENCH_*.json`` that carries a ``parsed`` key
+    whose payload is null/empty: such a file records that a measurement
+    RAN, while the measured values themselves are lost — the r05 failure
+    mode this gate exists to catch at commit time, not at verdict time.
+    The rule itself (and the BENCH_r05 grandfather list) lives in ONE
+    place, ``check_perf_regression.null_parsed_problem`` — the two gates
+    must never diverge on what counts as damaged."""
+    import glob
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'check_perf_regression',
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     'check_perf_regression.py'))
+    perf_gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(perf_gate)
+    errors = []
+    for path in sorted(glob.glob(os.path.join(root, 'BENCH_*.json'))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                blob = json.load(f)
+        except ValueError as e:
+            errors.append('{}: unreadable JSON ({})'.format(name, e))
+            continue
+        problem = perf_gate.null_parsed_problem(name, blob)
+        if problem:
+            errors.append(problem)
+    return errors
 
 
 def _lookup(blob, keypath: str):
@@ -172,6 +204,7 @@ def main(argv):
                 all_errors.append(
                     'required artifact {} is not referenced by any bench '
                     'annotation in the default docs'.format(artifact))
+        all_errors.extend(check_artifacts_intact())
     if all_errors:
         for err in all_errors:
             print('BENCH-DOCS MISMATCH: {}'.format(err), file=sys.stderr)

@@ -1,0 +1,374 @@
+#!/usr/bin/env python
+"""Perf-trajectory regression gate over the committed ``BENCH_*.json``
+artifacts.
+
+The repo has accumulated one benchmark artifact per PR round in several
+ad-hoc schemas (driver tail captures wrapping ``bench.py`` output, raw
+overhead-bench dicts, the shared-cache protocol record). This gate
+normalizes every committed artifact into ONE trajectory of
+
+    {artifact, round, benchmark, config, samples_per_sec, roofline_pct}
+
+entries and enforces three structural invariants:
+
+1. **No silent regressions.** For every (benchmark, config) series with
+   history, the latest committed round must be within the noise allowance
+   of the best earlier round — ``MAX_DROP_PCT`` (15%), widened to the
+   measured dispersion spread when either endpoint recorded one (a series
+   whose own artifact says "±30% run variance" cannot honestly gate at
+   15%). Beyond it, a PR made a line slower and must say so. Configs are
+   compared like-for-like only (``platform`` is part of the config: a CPU
+   quick run never gates against a TPU round), and gating starts at
+   ``GATED_FROM_ROUND`` — rounds 1-5 predate the dispersion-stabilized
+   protocol (VERDICT.md r05: 84.6% headline spread, windows too short)
+   and are carried as context, not as baselines.
+2. **No damaged records.** A committed ``BENCH_*.json`` whose ``parsed``
+   payload is null/empty is a round whose headline number is lost
+   (BENCH_r05.json, VERDICT.md) — rejected, except for the explicitly
+   grandfathered ``KNOWN_DAMAGED`` list (history cannot be rewritten; new
+   damage cannot hide behind it).
+3. **No context-free numbers going forward.** From round
+   ``ROOFLINE_REQUIRED_FROM_ROUND`` (12, the round that introduced the
+   roofline profiler) every new artifact must carry roofline context —
+   samples/s without a measured ceiling is exactly the unjudgeable number
+   VERDICT.md complained about.
+
+Quick-mode benches append local (uncommitted) entries to
+``PERF_TRAJECTORY.jsonl`` via :func:`append_entries` — context for humans
+reading the trajectory, never gating (their configs are host-local).
+
+Usage::
+
+    python ci/check_perf_regression.py            # gate (exit 1 on red)
+    python ci/check_perf_regression.py --print    # dump the trajectory
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Regression allowance: a latest-round samples/s more than this far below
+#: the best earlier committed round for the same (benchmark, config) fails.
+#: Widened per series to the recorded dispersion spread when present.
+MAX_DROP_PCT = 15.0
+
+#: First round the regression gate enforces. The round 1-5 artifacts are
+#: driver tail captures from the pre-dispersion-protocol era (VERDICT.md:
+#: 84.6% spread across identical runs, twice-violated consistency
+#: invariants) — they stay in the trajectory as context but cannot anchor
+#: a 15% gate in either direction.
+GATED_FROM_ROUND = 6
+
+#: Artifacts with a damaged ``parsed`` payload that predate this gate.
+#: BENCH_r05.json lost its headline to the driver's tail-capture window
+#: (VERDICT.md "What's weak" #1); bench.py now bounds its summary line and
+#: writes ``--out`` atomically so no new artifact can join this list.
+KNOWN_DAMAGED = frozenset({'BENCH_r05.json'})
+
+#: From this round on, an artifact without roofline context (a ``roofline``
+#: section or per-line ``roofline_pct``) is rejected.
+ROOFLINE_REQUIRED_FROM_ROUND = 12
+
+#: The local (uncommitted) trajectory file quick benches append to.
+LOCAL_TRAJECTORY = 'PERF_TRAJECTORY.jsonl'
+
+_ROUND_RE = re.compile(r'BENCH_r(\d+)\D')
+
+
+def _round_of(name: str):
+    match = _ROUND_RE.search(name)
+    return int(match.group(1)) if match else None
+
+
+def _entry(artifact, round_no, benchmark, config, samples_per_sec,
+           roofline_pct=None, committed=True, spread_pct=None):
+    return {
+        'artifact': artifact,
+        'round': round_no,
+        'benchmark': benchmark,
+        'config': config,
+        'samples_per_sec': float(samples_per_sec),
+        'roofline_pct': roofline_pct,
+        'spread_pct': spread_pct,
+        'committed': committed,
+    }
+
+
+def null_parsed_problem(name: str, blob) -> str:
+    """The ONE definition of the damaged-record rule (shared with
+    ``check_bench_docs.check_artifacts_intact`` — both gates must agree on
+    what counts as damaged and on the grandfather list): a dict artifact
+    carrying a ``parsed`` key whose payload is null/empty records that a
+    measurement RAN while its values are lost. Returns the problem string,
+    or ``''`` when the artifact is intact or grandfathered."""
+    if not (isinstance(blob, dict) and 'parsed' in blob
+            and not blob['parsed']):
+        return ''
+    if name in KNOWN_DAMAGED:
+        return ''
+    return ('{}: committed artifact has a null/empty "parsed" payload — '
+            'the measured record is lost; re-run bench.py with --out and '
+            'commit the full summary'.format(name))
+
+
+def _has_roofline_context(blob) -> bool:
+    """True when any node of the artifact carries roofline context."""
+    if isinstance(blob, dict):
+        if 'roofline' in blob or 'roofline_pct' in blob \
+                or 'roofline_fraction' in blob:
+            return True
+        return any(_has_roofline_context(v) for v in blob.values())
+    if isinstance(blob, list):
+        return any(_has_roofline_context(v) for v in blob)
+    return False
+
+
+def _bench_summary_entries(artifact, round_no, parsed):
+    """Entries from a ``bench.py`` summary dict (full or compact schema)."""
+    entries = []
+    platform = None
+    northstar = parsed.get('northstar')
+    if isinstance(northstar, dict):
+        platform = northstar.get('platform')
+    platform = platform or parsed.get('platform') or 'unknown'
+    value = parsed.get('value')
+    if isinstance(value, (int, float)):
+        dispersion = parsed.get('dispersion') or {}
+        proto = dispersion.get('protocol') or {}
+        config = {'platform': platform,
+                  'statistic': parsed.get('statistic', 'best'),
+                  'workers': proto.get('workers'),
+                  'rows': proto.get('rows')}
+        entries.append(_entry(artifact, round_no, 'hello_world', config,
+                              value,
+                              spread_pct=dispersion.get('spread_pct')))
+    for name, line in (northstar or {}).items():
+        if not isinstance(line, dict):
+            continue
+        sps = line.get('samples_per_sec') or line.get('sps')
+        if not isinstance(sps, (int, float)):
+            continue
+        roofline_pct = line.get('roofline_pct')
+        if roofline_pct is None and isinstance(line.get('roofline'), dict):
+            roofline_pct = line['roofline'].get('roofline_pct')
+        entries.append(_entry(artifact, round_no,
+                              'northstar.{}'.format(name),
+                              {'platform': platform}, sps,
+                              roofline_pct=roofline_pct))
+    # bench.py full summaries carry the roofline bench under
+    # 'roofline_bench'; a bare roofline artifact may sit under 'roofline'
+    for key in ('roofline_bench', 'roofline'):
+        roofline = parsed.get(key)
+        if isinstance(roofline, dict) and roofline.get('benchmark'):
+            entries.extend(_roofline_entries(artifact, round_no, roofline))
+            break
+    return entries
+
+
+def _roofline_entries(artifact, round_no, blob):
+    """Entries from a ``benchmark/roofline.py`` result."""
+    sps = blob.get('measured_samples_per_sec')
+    if not isinstance(sps, (int, float)):
+        return []
+    config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+              'workers': blob.get('workers'),
+              'rows': blob.get('rows')}
+    roof = blob.get('roofline') or {}
+    return [_entry(artifact, round_no,
+                   blob.get('benchmark', 'roofline_mnist_decode'),
+                   config, sps, roofline_pct=roof.get('roofline_pct'))]
+
+
+def _overhead_entries(artifact, round_no, blob):
+    """Entries from the alternating-pass overhead benches (r08/r09/r10):
+    the stable signal is the BASELINE items/s (the overhead pct is a claim
+    about a delta, not a rate)."""
+    baseline = blob.get('baseline_items_per_s')
+    if not isinstance(baseline, (int, float)):
+        return []
+    config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+              'rows': blob.get('rows'), 'workers': blob.get('workers')}
+    return [_entry(artifact, round_no, 'overhead_baseline_items_per_s',
+                   config, baseline)]
+
+
+def _shared_cache_entries(artifact, round_no, blob):
+    """Entries from the shared-cache protocol record (r11): the measured
+    serial roofline and the aggregate fleet rate."""
+    entries = []
+    config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+              'k_readers': blob.get('k_readers'), 'rows': blob.get('rows')}
+    roof = (blob.get('roofline') or {}).get('samples_per_sec')
+    if isinstance(roof, (int, float)):
+        entries.append(_entry(artifact, round_no,
+                              'shared_cache.io_decode_roofline', config,
+                              roof))
+    agg = (blob.get('shared') or {}).get('aggregate_samples_per_sec')
+    if isinstance(agg, (int, float)):
+        roofline_pct = None
+        if isinstance(roof, (int, float)) and roof:
+            roofline_pct = round(100.0 * agg / roof, 2)
+        entries.append(_entry(artifact, round_no,
+                              'shared_cache.aggregate', config, agg,
+                              roofline_pct=roofline_pct))
+    return entries
+
+
+def normalize_artifact(name: str, blob: dict):
+    """``(entries, problems)`` for one committed artifact. Problems are
+    gate failures (damaged record, missing roofline context); an artifact
+    in an unrecognized-but-intact schema yields no entries and no
+    problems (the gate must not block new benchmark shapes)."""
+    entries, problems = [], []
+    round_no = _round_of(name)
+    payload = blob
+    if 'parsed' in blob:
+        payload = blob.get('parsed')
+        if not payload:
+            problem = null_parsed_problem(name, blob)
+            if problem:
+                problems.append(problem)
+            return entries, problems
+    if not isinstance(payload, dict):
+        return entries, problems
+    if 'value' in payload or 'northstar' in payload:
+        entries.extend(_bench_summary_entries(name, round_no, payload))
+    elif payload.get('benchmark', '').startswith('roofline'):
+        entries.extend(_roofline_entries(name, round_no, payload))
+    elif 'baseline_items_per_s' in payload:
+        entries.extend(_overhead_entries(name, round_no, payload))
+    elif 'shared' in payload and 'roofline' in payload:
+        entries.extend(_shared_cache_entries(name, round_no, payload))
+    if (round_no is not None and round_no >= ROOFLINE_REQUIRED_FROM_ROUND
+            and not _has_roofline_context(payload)):
+        problems.append(
+            '{}: artifacts from round {} on must carry roofline context '
+            '(a "roofline" section or per-line "roofline_pct") — '
+            'samples/s without a measured ceiling is unjudgeable'.format(
+                name, ROOFLINE_REQUIRED_FROM_ROUND))
+    return entries, problems
+
+
+def load_trajectory(root: str = ROOT):
+    """``(entries, problems)`` across every committed ``BENCH_*.json`` plus
+    the local (non-gating) ``PERF_TRAJECTORY.jsonl`` appendix."""
+    entries, problems = [], []
+    for path in sorted(glob.glob(os.path.join(root, 'BENCH_*.json'))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                blob = json.load(f)
+        except ValueError as e:
+            problems.append('{}: unreadable JSON: {}'.format(name, e))
+            continue
+        got, bad = normalize_artifact(name, blob)
+        entries.extend(got)
+        problems.extend(bad)
+    local = os.path.join(root, LOCAL_TRAJECTORY)
+    if os.path.exists(local):
+        with open(local) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    continue
+                entry['committed'] = False
+                entries.append(entry)
+    return entries, problems
+
+
+def _config_key(config) -> str:
+    return json.dumps(config or {}, sort_keys=True)
+
+
+def check_regressions(entries):
+    """Latest committed round vs the best earlier committed round per
+    (benchmark, config), both from :data:`GATED_FROM_ROUND` on: a drop
+    beyond the noise allowance (``MAX_DROP_PCT``, widened to either
+    endpoint's recorded dispersion spread) fails."""
+    series = {}
+    for entry in entries:
+        if not entry.get('committed', True) or entry.get('round') is None:
+            continue
+        if entry['round'] < GATED_FROM_ROUND:
+            continue
+        key = (entry['benchmark'], _config_key(entry.get('config')))
+        series.setdefault(key, []).append(entry)
+    problems = []
+    for (benchmark, _cfg), points in sorted(series.items()):
+        points.sort(key=lambda e: e['round'])
+        latest_round = points[-1]['round']
+        earlier = [p for p in points if p['round'] < latest_round]
+        if not earlier:
+            continue
+        latest_entry = max((p for p in points if p['round'] == latest_round),
+                           key=lambda p: p['samples_per_sec'])
+        best_entry = max(earlier, key=lambda p: p['samples_per_sec'])
+        latest = latest_entry['samples_per_sec']
+        best = best_entry['samples_per_sec']
+        if best <= 0:
+            continue
+        allowance = max(MAX_DROP_PCT,
+                        latest_entry.get('spread_pct') or 0.0,
+                        best_entry.get('spread_pct') or 0.0)
+        drop_pct = 100.0 * (best - latest) / best
+        if drop_pct > allowance:
+            problems.append(
+                '{}: round {} measured {:.1f} samples/s, a {:.1f}% drop '
+                'vs the best committed baseline {:.1f} ({} round {}) — '
+                'beyond the {:.0f}% noise allowance'.format(
+                    benchmark, latest_round, latest, drop_pct, best,
+                    best_entry['artifact'], best_entry['round'],
+                    allowance))
+    return problems
+
+
+def append_entries(entries, root: str = ROOT,
+                   path: str = LOCAL_TRAJECTORY) -> str:
+    """Append normalized quick-bench entries to the local trajectory file
+    (JSON-lines; uncommitted context, never gating)."""
+    out = os.path.join(root, path)
+    with open(out, 'a') as f:
+        for entry in entries:
+            f.write(json.dumps(dict(entry, committed=False),
+                               sort_keys=True) + '\n')
+    return out
+
+
+def main(argv):
+    args = list(argv[1:])
+    root = ROOT
+    if '--root' in args:
+        root = args[args.index('--root') + 1]
+    entries, problems = load_trajectory(root)
+    problems.extend(check_regressions(entries))
+    if '--print' in args:
+        for entry in sorted(entries,
+                            key=lambda e: (e['benchmark'], e.get('round')
+                                           if e.get('round') is not None
+                                           else 9999)):
+            print(json.dumps(entry, sort_keys=True))
+    if problems:
+        for problem in problems:
+            print('PERF-TRAJECTORY: {}'.format(problem), file=sys.stderr)
+        return 1
+    committed = sum(1 for e in entries if e.get('committed', True))
+    print('perf-trajectory gate: {} entries ({} committed) across {} '
+          'series; no regression beyond {:.0f}%'.format(
+              len(entries), committed,
+              len({(e['benchmark'], _config_key(e.get('config')))
+                   for e in entries}), MAX_DROP_PCT))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv))

@@ -55,8 +55,17 @@ python -m pytest tests/test_sharedcache.py -q
 echo '== shared-cache quick bench (K readers x one dataset, decoded once) =='
 python -m petastorm_tpu.benchmark.shared_cache --quick
 
+echo '== profiler quick checks (attribution, calibration cache, advisor, /profile) =='
+python -m pytest tests/test_profiler.py -q
+
+echo '== roofline quick bench (calibrated ceilings + attribution on the mnist decode line) =='
+python -m petastorm_tpu.benchmark.roofline --quick
+
 echo '== bench-docs consistency gate =='
 python ci/check_bench_docs.py
+
+echo '== perf-trajectory regression gate (committed BENCH_*.json) =='
+python ci/check_perf_regression.py
 
 echo '== multi-chip dry run (8 virtual devices) =='
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -71,6 +71,15 @@ def build_parser() -> argparse.ArgumentParser:
                              'measured samples, so the in-flight tail epoch '
                              'honestly reads as dropped; judge the fully '
                              'consumed epochs (see docs/lineage.md)')
+    parser.add_argument('--profile', action='store_true',
+                        help='Roofline-profile the median run: calibrate '
+                             'per-stage ceilings against this dataset '
+                             '(storage, codec decode, transport, device '
+                             'staging; cached per host+dataset), report '
+                             'measured samples/sec as a %% of the binding '
+                             "stage's ceiling, and print the what-if "
+                             "advisor's ranked knob recommendations (see "
+                             'docs/profiling.md)')
     parser.add_argument('--cache-type', default='null',
                         choices=['null', 'local-disk', 'shared'],
                         help="row-group cache: 'null' (none), 'local-disk' "
@@ -119,6 +128,7 @@ def main(argv=None) -> int:
         metrics_interval=args.metrics_interval,
         metrics_out=args.metrics_out, debug_port=args.debug_port,
         stall_timeout=args.stall_timeout, audit=args.audit,
+        profile=args.profile,
         on_decode_error=args.on_decode_error, cache_type=args.cache_type,
         cache_location=args.cache_location,
         cache_size_limit=args.cache_size_limit)
@@ -146,6 +156,13 @@ def main(argv=None) -> int:
             # (infeed_diagnosis over the snapshot + live heartbeats)
             print('Infeed diagnosis (median run): {}'.format(
                 json.dumps(result.diagnosis, sort_keys=True)))
+    if args.profile and result.profile is not None:
+        import json
+
+        from petastorm_tpu.profiler import explain
+        print('Roofline (median run): {}'.format(explain(result.profile)))
+        print('Roofline profile: {}'.format(
+            json.dumps(result.profile, sort_keys=True, default=str)))
     if args.audit and result.audit is not None:
         import json
         print('Coverage audit (median run): {}'.format(

@@ -140,35 +140,62 @@ def run_mnist_train_bench(dataset_url: str, batch_size: int = 512,
             count_fn=lambda b: int(b['label'].shape[0]))
 
 
+def _shared_cache_kwargs(cache_dir: str) -> dict:
+    """Reader kwargs for the host-wide tiered shared cache (ROADMAP item 4:
+    the cached north-star lines ride ``cache_type='shared'``, not per-reader
+    ``local-disk``). The shared-memory tier is pointed inside the bench
+    scratch so an aborted run leaves nothing behind in ``/dev/shm``."""
+    import os
+    return dict(cache_type='shared', cache_location=cache_dir,
+                cache_size_limit=20 * 2**30,
+                cache_extra_settings={
+                    'mem_dir': os.path.join(cache_dir, 'mem')})
+
+
 def run_mnist_cached_train_bench(dataset_url: str, rows: int,
                                  batch_size: int = 512,
                                  num_steps: int = 60,
                                  workers_count: int = None,
                                  hidden: int = 2048,
-                                 prefetch: int = 4) -> InfeedReport:
+                                 prefetch: int = 4,
+                                 cache_location: str = None) -> InfeedReport:
     """Steady-state epochs with the device-side epoch cache: epoch 1 decodes
     from parquet and stages every batch into HBM; epochs 2+ replay the device
     arrays with zero host work (``jax_utils.epoch_cache_on_device``, the
     device-side upgrade of the reference's
     ``BatchedDataLoader(inmemory_cache_all=True)``, ``pytorch.py:292-321``).
     Warmup spans the whole first epoch so the measured window is pure steady
-    state."""
+    state. The fill epoch's reader publishes its decoded row groups into the
+    host-wide shared cache (``cache_type='shared'``) so concurrent readers
+    of the same store skip the decode the device cache already paid."""
+    import tempfile
+
     from petastorm_tpu import make_columnar_reader
     from petastorm_tpu.jax_utils import JaxDataLoader, epoch_cache_on_device
 
     step_fn = _make_mnist_step(hidden)
-    with make_columnar_reader(dataset_url, reader_pool_type='thread',
-                              workers_count=workers_count or _default_workers(),
-                              num_epochs=1) as reader:
-        loader = JaxDataLoader(reader, batch_size=batch_size, drop_last=True)
-        # Warmup must span the entire cache-fill epoch (plus compile steps) so
-        # the measured window replays device arrays only.
-        steps_per_epoch = max(1, rows // batch_size)
-        batches = epoch_cache_on_device(loader)
-        return measure_infeed_overlap(
-            batches, step_fn, num_steps=num_steps,
-            warmup_steps=steps_per_epoch + 2,
-            count_fn=lambda b: int(b['label'].shape[0]))
+    cache_dir = cache_location or tempfile.mkdtemp(
+        prefix='petastorm_tpu_mnist_shared_cache_')
+    try:
+        with make_columnar_reader(dataset_url, reader_pool_type='thread',
+                                  workers_count=(workers_count
+                                                 or _default_workers()),
+                                  num_epochs=1,
+                                  **_shared_cache_kwargs(cache_dir)) as reader:
+            loader = JaxDataLoader(reader, batch_size=batch_size,
+                                   drop_last=True)
+            # Warmup must span the entire cache-fill epoch (plus compile
+            # steps) so the measured window replays device arrays only.
+            steps_per_epoch = max(1, rows // batch_size)
+            batches = epoch_cache_on_device(loader)
+            return measure_infeed_overlap(
+                batches, step_fn, num_steps=num_steps,
+                warmup_steps=steps_per_epoch + 2,
+                count_fn=lambda b: int(b['label'].shape[0]))
+    finally:
+        if cache_location is None:
+            import shutil
+            shutil.rmtree(cache_dir, ignore_errors=True)
 
 
 def generate_imagenet_dataset(output_url: str, rows: int = 256,
@@ -277,14 +304,16 @@ def run_imagenet_cached_train_bench(dataset_url: str, rows: int,
                                     image_size: int = 224,
                                     decode_hints=None,
                                     cache_location: str = None) -> InfeedReport:
-    """ImageNet-class training with the decoded-columns disk cache — the
+    """ImageNet-class training with the host-wide tiered shared cache — the
     epoch≥2 story for stores too big for HBM (device cache) on a decode-poor
-    host. Epoch 1 decodes + resizes and the columnar worker caches the
-    POST-transform columns on disk (the reference's
+    host. Epoch 1 decodes + resizes and the columnar worker publishes the
+    POST-transform columns into the shared decoded tier (``cache_type=
+    'shared'``: shm segments + disk spill — the reference's
     ``LocalDiskArrowTableCache`` role, ``local_disk_arrow_table_cache.py:
-    20-40``, with the reference's cache-wraps-transform batch semantics);
-    epochs 2+ skip png/jpeg decode AND resize entirely. Warmup spans the
-    whole fill epoch so the measured window replays cache only."""
+    20-40``, upgraded from the per-reader ``local-disk`` store this line
+    used through r11 so every reader on the host shares one fill); epochs
+    2+ skip png/jpeg decode AND resize entirely. Warmup spans the whole
+    fill epoch so the measured window replays cache only."""
     import tempfile
 
     import jax
@@ -314,9 +343,7 @@ def run_imagenet_cached_train_bench(dataset_url: str, rows: int,
                                   transform_spec=make_resize_transform(
                                       image_size),
                                   decode_hints=decode_hints,
-                                  cache_type='local-disk',
-                                  cache_location=cache_dir,
-                                  cache_size_limit=20 * 2**30) as reader:
+                                  **_shared_cache_kwargs(cache_dir)) as reader:
             loader = JaxDataLoader(reader, batch_size=batch_size,
                                    drop_last=True)
             batches = prefetch_to_device(iter(loader), size=prefetch)

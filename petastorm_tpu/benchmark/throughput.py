@@ -37,6 +37,12 @@ class ThroughputResult:
     #: taken after the run when requested via ``audit=True`` — what the
     #: CLI's ``--audit`` prints. ``None`` when not requested.
     audit: Optional[dict] = None
+    #: The roofline profile (``reader.profile()``) of the measured window
+    #: when requested via ``profile=True``: measured samples/s vs the
+    #: calibrated per-stage ceilings, binding stage, advisor
+    #: recommendations — what the CLI's ``--profile`` prints. ``None``
+    #: when not requested (see ``docs/profiling.md``).
+    profile: Optional[dict] = None
 
 
 def _consume(iterator, count: int, batched: bool) -> int:
@@ -71,6 +77,7 @@ def reader_throughput(dataset_url: str,
                       debug_port=None,
                       stall_timeout: float = 0,
                       audit: bool = False,
+                      profile: bool = False,
                       on_decode_error: str = 'raise',
                       cache_type: str = 'null',
                       cache_location: Optional[str] = None,
@@ -147,6 +154,18 @@ def reader_throughput(dataset_url: str,
             lineage = getattr(reader, 'lineage', None)
             audit_report = (lineage.coverage_report()
                             if lineage is not None else {'enabled': False})
+        profile_report = None
+        if profile:
+            # the measured window's own samples/s is the honest numerator
+            # (jax mode counts batch rows; row mode counts rows) — probes
+            # run after the measurement so they cannot perturb it
+            profile_report = reader.profile(
+                samples_per_sec=actual / elapsed)
+            diagnosis['roofline'] = {
+                k: profile_report.get(k)
+                for k in ('measured_samples_per_s', 'binding_stage',
+                          'binding_ceiling_samples_per_s',
+                          'roofline_fraction')}
 
     return ThroughputResult(samples_per_sec=actual / elapsed,
                             warmup_cycles=warmup_cycles,
@@ -154,4 +173,5 @@ def reader_throughput(dataset_url: str,
                             rss_mb=rss, cpu_percent=cpu,
                             diagnostics=diagnostics,
                             diagnosis=diagnosis,
-                            audit=audit_report)
+                            audit=audit_report,
+                            profile=profile_report)

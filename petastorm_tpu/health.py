@@ -323,12 +323,17 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
                         snapshot: Optional[dict] = None,
                         queues: Optional[dict] = None,
                         tracer=None, span_tail: int = 500,
-                        lineage: Optional[dict] = None) -> dict:
+                        lineage: Optional[dict] = None,
+                        roofline: Optional[dict] = None) -> dict:
     """Assemble the flight-recorder artifact: everything needed to diagnose
     a stall *after* the process is gone. JSON-able by construction.
     ``lineage`` (a tracker's ``flight_summary()``) adds the coverage audit
     and recent quarantine records, so a stall dump also answers "what data
-    had the model seen, and what was dropped" (see ``docs/lineage.md``)."""
+    had the model seen, and what was dropped" (see ``docs/lineage.md``).
+    ``roofline`` (a profiler ``roofline_summary()``) records how far below
+    its calibrated ceiling the pipeline was running when it died — a stall
+    that follows a long degradation reads differently from one out of the
+    blue (see ``docs/profiling.md``)."""
     record = {
         'kind': 'petastorm_tpu_flight_record',
         'written_at': time.time(),
@@ -344,6 +349,8 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
         record['spans_dropped'] = tracer.dropped
     if lineage is not None:
         record['lineage'] = lineage
+    if roofline is not None:
+        record['roofline'] = roofline
     return record
 
 
@@ -476,6 +483,11 @@ class DebugServer:
       (:meth:`petastorm_tpu.lineage.LineageTracker.coverage_report`):
       per-epoch exactly-once verdicts, dup/drop row groups, shuffle quality,
       quarantine totals. 404 when the reader runs with lineage disabled.
+    - ``GET /profile`` — the roofline profile
+      (:meth:`petastorm_tpu.reader.Reader.profile`): measured samples/s vs
+      the calibrated per-stage ceilings, binding stage, overlap-aware
+      attribution, advisor recommendations. 404 when the profiler is
+      disabled (``PETASTORM_TPU_PROFILER=0``) or not wired.
     - ``GET /stacks`` — plain-text stack dump of every in-process thread.
 
     Requests are served on daemon threads (``ThreadingHTTPServer``);
@@ -487,11 +499,13 @@ class DebugServer:
                  snapshot_fn: Optional[Callable[[], dict]] = None,
                  heartbeats_fn: Optional[Callable[[], Dict[str, dict]]] = None,
                  port: int = 0, prefix: str = 'petastorm_tpu',
-                 coverage_fn: Optional[Callable[[], dict]] = None):
+                 coverage_fn: Optional[Callable[[], dict]] = None,
+                 profile_fn: Optional[Callable[[], dict]] = None):
         self._evaluate_fn = evaluate_fn
         self._snapshot_fn = snapshot_fn or (lambda: {})
         self._heartbeats_fn = heartbeats_fn or (lambda: {})
         self._coverage_fn = coverage_fn
+        self._profile_fn = profile_fn
         self._requested_port = port
         self._prefix = prefix
         self._server = None
@@ -547,6 +561,17 @@ class DebugServer:
                             self._reply(200, 'application/json',
                                         json.dumps(outer._coverage_fn(),
                                                    default=str))
+                    elif route == '/profile':
+                        if outer._profile_fn is None:
+                            self._reply(404, 'text/plain',
+                                        'the roofline profiler is disabled '
+                                        'for this reader '
+                                        '(PETASTORM_TPU_PROFILER=0 or no '
+                                        'profile source wired)\n')
+                        else:
+                            self._reply(200, 'application/json',
+                                        json.dumps(outer._profile_fn(),
+                                                   default=str))
                     elif route == '/stacks':
                         stacks = thread_stacks()
                         body = '\n'.join('== {} ==\n{}'.format(name, stack)
@@ -556,8 +581,8 @@ class DebugServer:
                     else:
                         self._reply(404, 'text/plain',
                                     'unknown route {}; try /healthz /metrics '
-                                    '/diagnostics /coverage /stacks\n'
-                                    .format(route))
+                                    '/diagnostics /coverage /profile '
+                                    '/stacks\n'.format(route))
                 except Exception as e:  # report, never kill the serve loop
                     logger.exception('debug endpoint request failed')
                     try:
@@ -575,7 +600,8 @@ class DebugServer:
                                         name='petastorm-tpu-debug-http')
         self._thread.start()
         logger.info('petastorm_tpu debug endpoint on http://127.0.0.1:%d '
-                    '(/healthz /metrics /diagnostics /stacks)', self.port)
+                    '(/healthz /metrics /diagnostics /profile /stacks)',
+                    self.port)
         return self
 
     def stop(self) -> None:

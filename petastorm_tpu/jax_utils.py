@@ -713,7 +713,7 @@ def stage_to_global(batch, named_sharding, stats=None, tracer=None):
 
 
 def infeed_diagnosis(snapshot: dict, heartbeats=None,
-                     stall_after_s=None) -> dict:
+                     stall_after_s=None, roofline=None) -> dict:
     """Classify an infeed pipeline from a ``ReaderStats`` snapshot
     (``reader.diagnostics`` / ``loader.stats.snapshot()``) and recommend the
     knobs that attack its bottleneck.
@@ -737,6 +737,13 @@ def infeed_diagnosis(snapshot: dict, heartbeats=None,
     call the watchdog and ``/healthz`` make, so the CLI's ``-d`` output and
     the debug endpoint can never disagree. ``stall_after_s`` defaults to
     :data:`petastorm_tpu.health.DEFAULT_STALL_AFTER_S`.
+
+    ``roofline`` (a :meth:`~petastorm_tpu.reader.Reader.profile` result or
+    its :func:`~petastorm_tpu.profiler.roofline_summary`) adds a
+    ``roofline`` section — measured samples/s as a fraction of the
+    calibrated binding-stage ceiling — so the diagnosis says not only
+    *which* stage binds but *how far from the host's measured limit* the
+    pipeline runs (see ``docs/profiling.md``).
     """
     from petastorm_tpu.health import (DEFAULT_STALL_AFTER_S,
                                       bottleneck_signals, classify_pipeline)
@@ -767,6 +774,11 @@ def infeed_diagnosis(snapshot: dict, heartbeats=None,
             # past, not the problem
             out['bottleneck'] = 'stalled'
             out['hint'] = verdict['hint']
+    if roofline is not None:
+        from petastorm_tpu.profiler import roofline_summary
+        out['roofline'] = (roofline_summary(roofline)
+                           if roofline.get('kind') ==
+                           'petastorm_tpu_roofline_profile' else roofline)
     return out
 
 

@@ -433,6 +433,20 @@ class Reader:
         self._debug_server = None
         self._flight_record_dir = flight_record_dir
         self.last_row_consumed = False
+        # -- roofline profiler state (see docs/profiling.md) ------------------
+        #: Most recent :meth:`profile` result (``None`` until the first call).
+        self._last_profile = None
+        #: ``stage_ceiling_*`` / ``roofline_fraction`` / ``binding_stage``
+        #: gauges merged into :meth:`_stats_snapshot` once a profile exists,
+        #: so ``/metrics`` and the metrics emitter expose %-of-ceiling.
+        self._roofline_gauges = {}
+        self._pool_type = {'ProcessPool': 'process', 'ThreadPool': 'thread',
+                           'DummyPool': 'dummy'}.get(type(pool).__name__,
+                                                     'thread')
+        self._cache_type = {'NullCache': 'null',
+                            'LocalDiskCache': 'local-disk',
+                            'SharedRowGroupCache': 'shared'}.get(
+                                type(cache).__name__, 'null')
         #: The pipeline's :class:`~petastorm_tpu.health.HealthMonitor`:
         #: per-entity heartbeats from the ventilator, the pool's workers
         #: (plus their readahead threads), and — when wired via
@@ -535,6 +549,7 @@ class Reader:
                          else io_readahead)
         else:
             lookahead = 0
+        self._io_readahead = io_readahead
         # -- sample lineage (see docs/lineage.md) ------------------------------
         import hashlib
         dataset_digest = hashlib.md5(
@@ -609,8 +624,10 @@ class Reader:
         pool.lineage = self.lineage
         pool.start(worker_class, worker_args, self._ventilator)
         if metrics_interval:
+            # the reader-level snapshot folds in the roofline gauges once a
+            # profile exists, so emitted series gain %-of-ceiling context
             self._metrics_emitter = MetricsEmitter(
-                pool.stats.snapshot, metrics_interval, metrics_out)
+                self._stats_snapshot, metrics_interval, metrics_out)
             self._metrics_emitter.start()
 
         # -- live health layer (see docs/health.md) ---------------------------
@@ -629,11 +646,14 @@ class Reader:
             if stall_timeout:
                 self._watchdog.start()
         if resolved_debug_port is not None:
+            from petastorm_tpu.profiler import profiler_enabled
             self._debug_server = DebugServer(
-                self._watchdog.evaluate, pool.stats.snapshot,
+                self._watchdog.evaluate, self._stats_snapshot,
                 self.health.heartbeats, port=resolved_debug_port,
                 coverage_fn=(self.lineage.coverage_report
-                             if self.lineage.enabled else None))
+                             if self.lineage.enabled else None),
+                profile_fn=(self._profile_route if profiler_enabled()
+                            else None))
             try:
                 self._debug_server.start()
             except (OSError, OverflowError) as e:   # taken / out-of-range port
@@ -840,17 +860,98 @@ class Reader:
             'shuffle_buffer_depth': snapshot.get('shuffle_buffer_depth', 0),
             'readahead_depth': snapshot.get('readahead_depth', 0),
         }
+        roofline = None
+        if self._last_profile is not None:
+            from petastorm_tpu.profiler import roofline_summary
+            roofline = roofline_summary(self._last_profile)
         record = build_flight_record(verdict, self.health.heartbeats(),
                                      snapshot, queues, tracer=self.tracer,
                                      lineage=(self.lineage.flight_summary()
                                               if self.lineage.enabled
-                                              else None))
+                                              else None),
+                                     roofline=roofline)
         if path is None:
             import tempfile
             out_dir = self._flight_record_dir or tempfile.gettempdir()
             path = os.path.join(out_dir, 'petastorm_tpu_flight_{}_{}.json'
                                 .format(os.getpid(), int(time.time())))
         return write_flight_record(path, record)
+
+    # -- roofline profiler (see docs/profiling.md) -----------------------------
+
+    def _stats_snapshot(self):
+        """The pool's stats snapshot plus the roofline gauges of the most
+        recent :meth:`profile` call (``stage_ceiling_*``,
+        ``roofline_fraction``, ``binding_stage``) — what the metrics
+        emitter and the debug endpoint's ``/metrics`` serve, so scrapes
+        show %-of-ceiling, not just raw samples/s."""
+        snapshot = self._pool.stats.snapshot()
+        if self._roofline_gauges:
+            snapshot.update(self._roofline_gauges)
+        return snapshot
+
+    def profile(self, calibrate='auto', sample_row_groups: int = 3,
+                samples_per_sec=None):
+        """The roofline profile of this reader right now: measured rate vs
+        the calibrated per-stage ceilings of *this host on this dataset*,
+        the binding stage, overlap-aware span attribution, and the what-if
+        advisor's ranked knob recommendations.
+
+        ``calibrate`` picks how ceilings are obtained: ``'cached'`` only
+        loads a previously saved calibration artifact (cheap, never
+        probes), ``'auto'`` (default) probes on a cache miss, ``'force'``
+        always re-probes. Probes run on the calling thread against sampled
+        row groups — seconds of work, on demand, never on the hot path.
+        ``samples_per_sec`` overrides the measured rate when the caller
+        measured it directly (benchmarks do); otherwise it is estimated
+        from the stats window's items/s times the calibrated mean rows per
+        row group. See ``docs/profiling.md``."""
+        from petastorm_tpu import profiler
+        if not profiler.profiler_enabled():
+            raise RuntimeError('the roofline profiler is disabled via {}=0'
+                               .format(profiler.PROFILER_ENV_VAR))
+        # calibrate against the reader's VIEW schema, not the stored one: a
+        # column-pruned reader only pays for the columns it decodes, and
+        # the digest carries the view so differently-pruned readers over
+        # one store never share a calibration artifact
+        calibration = profiler.get_calibration(
+            self._filesystem_factory(), self._dataset_path, self._pieces,
+            self._worker_args['schema'], mode=calibrate,
+            sample_row_groups=sample_row_groups)
+        spans = self.tracer.spans() if self.tracer is not None else None
+        result = profiler.build_profile(
+            self._pool.stats.snapshot(), calibration, spans=spans,
+            samples_per_sec=samples_per_sec,
+            workers_count=self._pool.workers_count,
+            io_readahead=self._io_readahead, pool_type=self._pool_type,
+            cache_type=self._cache_type)
+        self._last_profile = result
+        self._roofline_gauges = profiler.roofline_gauges(result)
+        return result
+
+    def explain_throughput(self, calibrate='auto') -> str:
+        """One sentence: "measured X samples/s = Y% of the binding stage's
+        ceiling Z", plus the advisor's top recommendations. Runs
+        :meth:`profile` (probing on a calibration-cache miss unless
+        ``calibrate='cached'``)."""
+        from petastorm_tpu import profiler
+        return profiler.explain(self.profile(calibrate=calibrate))
+
+    def _profile_route(self):
+        """``GET /profile`` source. An HTTP probe must stay cheap: serve
+        the most recent :meth:`profile` result when one exists (periodic
+        scrapers must not recompute the dataset digest and span-union
+        attribution per request), and only build a fresh cached-calibration
+        profile (never probing) before the first ``profile()`` call."""
+        if self._last_profile is not None:
+            return dict(self._last_profile, from_cache=True)
+        fresh = self.profile(calibrate='cached')
+        if not fresh.get('calibrated'):
+            # don't pin an uncalibrated snapshot: the route stays live
+            # until a calibration exists, then starts serving the cache
+            self._last_profile = None
+            self._roofline_gauges = {}
+        return fresh
 
     # -- lineage (see docs/lineage.md) -----------------------------------------
 

@@ -241,10 +241,23 @@ def prometheus_text(snapshot: dict, prefix: str = 'petastorm_tpu') -> str:
     Non-numeric values are skipped; everything is exposed as a gauge (the
     snapshot is a point-in-time scrape, not a counter stream) with a
     ``# HELP`` line, and non-finite values use the spec's
-    ``NaN``/``+Inf``/``-Inf`` literals."""
+    ``NaN``/``+Inf``/``-Inf`` literals.
+
+    One string key is special-cased: ``binding_stage`` (the roofline
+    profiler's verdict — see ``docs/profiling.md``) exports as an
+    info-style labeled gauge ``<prefix>_binding_stage{stage="decode"} 1``,
+    the Prometheus idiom for categorical state."""
     lines = []
     for key in sorted(snapshot):
         value = snapshot[key]
+        if key == 'binding_stage' and isinstance(value, str) and value:
+            metric = '{}_{}'.format(prefix, key)
+            lines.append('# HELP {} the roofline profiler\'s binding '
+                         'pipeline stage (see docs/profiling.md)'
+                         .format(metric))
+            lines.append('# TYPE {} gauge'.format(metric))
+            lines.append('{}{{stage="{}"}} 1'.format(metric, value))
+            continue
         if not isinstance(value, (int, float)) or isinstance(value, bool):
             continue
         metric = '{}_{}'.format(prefix, key)
