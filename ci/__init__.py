@@ -1,0 +1,1 @@
+"""CI tooling package (``ci.analysis`` is the petalint static checker)."""

@@ -1,0 +1,29 @@
+"""petalint: AST invariant checker for the concurrency-critical pipeline.
+
+Every PR since the transport rewrite needed a hand-run "hardening from
+review" pass that kept catching the *same* invariant classes — non-atomic
+artifact publication, wall-clock timestamps in stall logic, blocking work
+inside lock bodies, ``except Exception`` swallowing infra errors, unnamed /
+unjoined threads, kill-switched subsystems with import-time side effects
+(see CHANGES.md, PRs 4-7). This package machine-checks those invariants the
+same way lockdep/sanitizers turn kernel lock-order bugs into CI failures:
+
+- one AST pass per file, pluggable :class:`~ci.analysis.engine.Rule` classes
+  (``ci/analysis/rules.py`` holds R1-R6, each annotated with the incident it
+  descends from — catalog in ``docs/static_analysis.md``);
+- inline ``# petalint: disable=<rule>`` suppressions for sites where the
+  flagged construct is the *intended* semantics (each carries a justifying
+  comment);
+- a committed baseline (``ci/analysis/baseline.json``) so pre-existing
+  findings gate new code without a big-bang fix. The baseline is validated
+  against the current source: an entry whose line no longer matches is an
+  error, so the baseline can only shrink. For first-party code it is empty.
+
+Run ``python -m ci.analysis`` from the repo root (``ci/run_tests.sh`` does,
+as a hard gate). The runtime companion is the lockdep-lite harness in
+:mod:`petastorm_tpu.test_util.lockdep`.
+"""
+
+from ci.analysis.engine import (Analyzer, Baseline, Finding, Rule,  # noqa: F401
+                                analyze_paths, main)
+from ci.analysis.rules import DEFAULT_RULES  # noqa: F401

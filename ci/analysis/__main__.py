@@ -1,0 +1,7 @@
+"""``python -m ci.analysis`` — run petalint (see docs/static_analysis.md)."""
+
+import sys
+
+from ci.analysis.engine import main
+
+sys.exit(main())

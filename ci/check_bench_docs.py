@@ -175,8 +175,10 @@ def check_file(doc_path: str, fix: bool = False):
 
     new_text = ANNOTATION.sub(handle, text)
     if fix and new_text != text:
-        with open(os.path.join(ROOT, doc_path), 'w') as f:
-            f.write(new_text)
+        # atomic rewrite: a crash mid-fix must not truncate a committed doc
+        from petastorm_tpu.utils import atomic_write
+        atomic_write(os.path.join(ROOT, doc_path),
+                     lambda f: f.write(new_text))
     return count, errors, referenced
 
 

@@ -16,6 +16,13 @@ export JAX_PLATFORMS=cpu
 
 LANE="${1:-fast}"
 
+echo '== petalint (AST invariant gate: atomic-publish, monotonic-clock,'
+echo '   lock-discipline, exception-hygiene, thread-lifecycle, kill-switch) =='
+# Hard gate: any non-baselined finding fails; a baseline entry whose line no
+# longer matches also fails (the baseline can only shrink). Rule catalog and
+# "petalint failed my PR" triage: docs/static_analysis.md.
+python -m ci.analysis
+
 case "$LANE" in
   fast)
     echo '== pytest (fast lane: -m "not slow") =='
@@ -37,8 +44,11 @@ python -m petastorm_tpu.benchmark.readahead --quick
 echo '== trace-overhead quick bench (span tracer on vs off) =='
 python -m petastorm_tpu.benchmark.trace_overhead --quick
 
-echo '== health quick checks (watchdog + debug endpoint + wedge fixtures) =='
-python -m pytest tests/test_health.py -q
+echo '== petalint self-tests (rule fixtures, baseline workflow, lockdep unit) =='
+python -m pytest tests/test_petalint.py -q
+
+echo '== health quick checks (watchdog + debug endpoint + wedge fixtures; lockdep on) =='
+PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_health.py -q
 
 echo '== health-overhead quick bench (heartbeats+watchdog+endpoint on vs off) =='
 python -m petastorm_tpu.benchmark.health_overhead --quick
@@ -49,8 +59,11 @@ python -m pytest tests/test_lineage.py -q
 echo '== lineage-overhead quick bench (provenance+audit ledgers on vs off) =='
 python -m petastorm_tpu.benchmark.lineage_overhead --quick
 
-echo '== shared-cache quick checks (tiered segments, pins, concurrent attach) =='
-python -m pytest tests/test_sharedcache.py -q
+echo '== shared-cache quick checks (tiered segments, pins, concurrent attach; lockdep on) =='
+PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_sharedcache.py -q
+
+echo '== worker-pool checks under the lockdep-lite harness (lock-order graph) =='
+PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_workers_pool.py -q
 
 echo '== shared-cache quick bench (K readers x one dataset, decoded once) =='
 python -m petastorm_tpu.benchmark.shared_cache --quick

@@ -68,8 +68,8 @@ def run(output_path: str = 'BENCH_scaling.json',
                  'unverifiable in this 1-core environment (predicted, not '
                  'measured; see docs/profile_mnist_decode.md).'),
     }
-    with open(output_path, 'w') as f:
-        json.dump(result, f, indent=2)
+    from petastorm_tpu.utils import atomic_write
+    atomic_write(output_path, lambda f: json.dump(result, f, indent=2))
     return result
 
 

@@ -336,7 +336,9 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
     blue (see ``docs/profiling.md``)."""
     record = {
         'kind': 'petastorm_tpu_flight_record',
-        'written_at': time.time(),
+        # deliberate wall clock: a human-facing artifact timestamp, never
+        # compared against monotonic readings
+        'written_at': time.time(),  # petalint: disable=monotonic-clock
         'pid': os.getpid(),
         'verdict': verdict,
         'heartbeats': heartbeats,

@@ -678,7 +678,9 @@ def make_quarantine_record(piece, piece_index: int, epoch: int,
         'partition': list(partition),
         'shard': shard,
         'rows': int(rows),
-        'ts': time.time(),
+        # deliberate wall clock: quarantine records are human-facing
+        # evidence ("when did the bad sample appear"), never aged
+        'ts': time.time(),  # petalint: disable=monotonic-clock
     }
     if field is not None:
         record['field'] = field

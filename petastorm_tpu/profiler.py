@@ -435,7 +435,9 @@ def calibrate(filesystem, dataset_path, pieces, schema,
         'cpu_count': os.cpu_count() or 1,
         'dataset_path': str(dataset_path),
         'dataset_digest': digest,
-        'written_at': time.time(),
+        # deliberate wall clock: artifact timestamp for humans, never
+        # compared against monotonic readings
+        'written_at': time.time(),  # petalint: disable=monotonic-clock
         'sampled_row_groups': len(sampled),
         'sampled_rows': decode['rows'],
         'total_rows': total_rows,

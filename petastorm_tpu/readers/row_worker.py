@@ -16,7 +16,7 @@ from typing import List
 
 import numpy as np
 
-from petastorm_tpu.lineage import unwrap_envelope
+from petastorm_tpu.lineage import NEVER_QUARANTINE, unwrap_envelope
 from petastorm_tpu.ngram import NGramWindowChunk
 from petastorm_tpu.readers.piece_worker import ParquetPieceWorker
 from petastorm_tpu.unischema import decode_row
@@ -382,6 +382,8 @@ class RowGroupWorker(ParquetPieceWorker):
             try:
                 out.append(self._apply_transform(row))
                 kept.append(i)
+            except NEVER_QUARANTINE:
+                raise   # infrastructure failure, not a bad sample: stay loud
             except Exception as e:  # noqa: BLE001 - policy decides
                 if offsets is None:
                     off = None

@@ -131,6 +131,29 @@ class CorruptSegmentError(Exception):
     it is dropped and refilled, never served."""
 
 
+def _fs_now(root: str) -> float:
+    """Filesystem-clock "now": the mtime of a freshly created probe file.
+
+    Lock and counter ages are computed as ``fs_now - st.st_mtime`` — both
+    sides read from the SAME clock (the filesystem's), so a step in this
+    process's wall clock (NTP correction) or a host whose clock disagrees
+    with the filesystem server's (network mounts) can neither steal a live
+    single-flight lock (forward step → duplicate decode) nor keep a dead
+    one un-stealable (backward step → wedged waiters). ``time.time()``
+    arithmetic against mtimes had exactly that hazard. Raises ``OSError`` when
+    the directory is gone (cache tearing down) — callers treat that as
+    "age unknown"."""
+    fd, tmp = tempfile.mkstemp(dir=root, suffix='.clk')
+    try:
+        os.close(fd)
+        return os.stat(tmp).st_mtime
+    finally:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
 def _pid_alive(pid: int) -> bool:
     if pid <= 0:
         return False
@@ -556,6 +579,7 @@ class SharedRowGroupCache(CacheBase):
         self._counter_path = os.path.join(
             self._counters_dir,
             '{}-{}.json'.format(os.getpid(), self._instance_token))
+        self._fs_clock_cache: Optional[Tuple[float, float]] = None
         self._sweep_stale_counters()
 
     def _sweep_stale_counters(self) -> None:
@@ -563,8 +587,8 @@ class SharedRowGroupCache(CacheBase):
         cache root does not accumulate one file per reader forever (the
         pin registry's dead-pid expiry, applied to counters — but with a
         TTL, so a just-finished fleet stays summable)."""
-        now = time.time()
         try:
+            now = _fs_now(self._counters_dir)
             names = os.listdir(self._counters_dir)
         except OSError:
             return
@@ -740,7 +764,7 @@ class SharedRowGroupCache(CacheBase):
             return -1
 
     def _read_lock_state(self, path: str):
-        """``(holder_pid, age_s)`` of a lock file, or ``None`` when it
+        """``(holder_pid, mtime)`` of a lock file, or ``None`` when it
         vanished/is unreadable."""
         try:
             st = os.stat(path)
@@ -748,19 +772,39 @@ class SharedRowGroupCache(CacheBase):
                 holder = self._parse_lock_holder(f.read())
         except OSError:
             return None
-        return holder, time.time() - st.st_mtime
+        return holder, st.st_mtime
+
+    def _lock_age(self, mtime: float) -> Optional[float]:
+        """Age of ``mtime`` against the filesystem clock, or ``None`` when
+        the clock cannot be probed (locks dir unwritable/full). The probe
+        is cached for 1 s and advanced with ``time.monotonic()`` deltas in
+        between — a clock step cannot land inside a monotonic delta, and
+        waiters polling at 2-20 ms stop paying a create+stat+unlink of
+        metadata ops per poll."""
+        mono = time.monotonic()
+        cached = self._fs_clock_cache
+        if cached is not None and mono - cached[1] < 1.0:
+            return cached[0] + (mono - cached[1]) - mtime
+        try:
+            fs = _fs_now(self._locks_dir)
+        except OSError:
+            self._fs_clock_cache = None
+            return None
+        self._fs_clock_cache = (fs, mono)
+        return fs - mtime
 
     def _lock_stale(self, digest: str) -> bool:
         state = self._read_lock_state(self._lock_path(digest))
         if state is None:
             return False      # lock vanished: not stale
-        holder, age = state
-        if holder < 0:
-            # unparsable holder: only age can prove staleness
-            return age > self._lock_timeout_s
-        if not _pid_alive(holder):
-            return True
-        return age > self._lock_timeout_s
+        holder, mtime = state
+        if holder >= 0 and not _pid_alive(holder):
+            return True       # dead holder: stale, no clock needed
+        # live (or unparsable) holder: only age can prove staleness; an
+        # unprobeable filesystem clock proves nothing — the pid-liveness
+        # path above still steals dead locks even on a full disk
+        age = self._lock_age(mtime)
+        return age is not None and age > self._lock_timeout_s
 
     def _steal_lock(self, digest: str) -> bool:
         """Claim-then-validate steal of a stale lock. Renaming the lock to
@@ -781,9 +825,10 @@ class SharedRowGroupCache(CacheBase):
         state = self._read_lock_state(claim)
         stale = True
         if state is not None:
-            holder, age = state
-            if age <= self._lock_timeout_s:
-                stale = holder < 0 or not _pid_alive(holder)
+            holder, mtime = state
+            if holder >= 0 and _pid_alive(holder):
+                age = self._lock_age(mtime)
+                stale = age is not None and age > self._lock_timeout_s
         if not stale:
             # mis-steal (the holder renewed between observation and claim):
             # put it back unless a new lock already exists

@@ -1,0 +1,329 @@
+"""lockdep-lite: a test-mode lock wrapper that turns lock-order inversions
+and blocking-while-locked into test failures.
+
+The static half of the concurrency gate (``ci/analysis``, rule R3) checks
+what is *lexically* inside a ``with lock:`` body; this module is the runtime
+half, modeled on the Linux kernel's lockdep: it learns the **lock
+acquisition graph** from real executions and fails the moment the graph
+grows a cycle — so an A→B / B→A inversion is caught the *first* time both
+orders are ever observed, on any threads, without needing the actual
+deadlock interleaving to strike in CI.
+
+How it works:
+
+- :class:`TrackedLock` / :class:`TrackedRLock` wrap the stdlib primitives.
+  Each thread keeps a stack of tracked locks it holds; acquiring lock ``B``
+  while holding ``A`` records the directed edge ``A → B`` (with the
+  acquisition site). If a path ``B → ... → A`` already exists, that is a
+  lock-order inversion: a :class:`LockOrderInversionError` is raised at the
+  acquisition site *and* recorded on the registry (worker funnels may
+  swallow the raise — see :meth:`LockdepRegistry.assert_clean`).
+- :func:`lockdep_enabled` patches the ``threading`` (and ``time``) module
+  attributes *of the target petastorm_tpu modules* with thin proxies, so
+  every ``threading.Lock()`` those modules construct while the harness is
+  active is tracked — without touching the interpreter-global ``threading``
+  module (pytest's own locks stay untracked). ``time.sleep`` in the target
+  modules becomes a **blocking-call guard**: sleeping while holding a
+  tracked lock raises :class:`BlockingCallWhileLockedError` (the runtime
+  twin of petalint R3).
+
+Opt-in via the ``PETASTORM_TPU_LOCKDEP=1`` env var and the autouse fixture
+in ``tests/conftest.py`` (applied to the ``test_sharedcache`` /
+``test_health`` / ``test_workers_pool`` lanes; ``ci/run_tests.sh`` runs
+them with the harness on). See ``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+import time
+import traceback
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Set, Tuple
+
+#: Env var gating the conftest fixture (default off: the harness costs a
+#: dict lookup per acquire and is a diagnostic, not a production layer).
+LOCKDEP_ENV_VAR = 'PETASTORM_TPU_LOCKDEP'
+
+#: Modules whose ``threading.Lock``/``RLock`` constructions (and
+#: ``time.sleep`` calls) are tracked while the harness is active — the
+#: concurrency-critical set from ``mypy.ini``/petalint R2's scope.
+DEFAULT_TARGET_MODULES = (
+    'petastorm_tpu.sharedcache',
+    'petastorm_tpu.health',
+    'petastorm_tpu.tracing',
+    'petastorm_tpu.lineage',
+    'petastorm_tpu.workers.thread_pool',
+    'petastorm_tpu.workers.stats',
+    'petastorm_tpu.workers.ventilator',
+    'petastorm_tpu.readers.readahead',
+    'petastorm_tpu.readers.piece_worker',
+)
+
+
+class LockdepError(AssertionError):
+    """Base class; an AssertionError so pytest renders it as a failure."""
+
+
+class LockOrderInversionError(LockdepError):
+    """Acquiring this lock would close a cycle in the acquisition graph."""
+
+
+class BlockingCallWhileLockedError(LockdepError):
+    """A blocking call (``time.sleep``) ran while holding a tracked lock."""
+
+
+class SelfDeadlockError(LockdepError):
+    """A thread blocked on a non-reentrant lock it already holds."""
+
+
+def _site(skip: int = 2) -> str:
+    """A short 'file:line in func' acquisition-site string."""
+    for frame in reversed(traceback.extract_stack()[:-skip]):
+        if 'lockdep' not in frame.filename:
+            return '{}:{} in {}'.format(frame.filename, frame.lineno,
+                                        frame.name)
+    return '<unknown>'
+
+
+class LockdepRegistry:
+    """The global acquisition graph plus per-thread held stacks.
+
+    Violations are both raised at the offending call site and appended to
+    :attr:`violations`, because the raise may happen on a worker thread
+    whose exception funnel ships it somewhere a test never looks —
+    :meth:`assert_clean` at fixture teardown is the backstop.
+    """
+
+    def __init__(self):
+        # internal mutex is a RAW lock: the registry must never trip itself
+        self._mu = threading.Lock()
+        self._edges: Dict[int, Set[int]] = {}
+        self._edge_sites: Dict[Tuple[int, int], str] = {}
+        self._names: Dict[int, str] = {}
+        self._tls = threading.local()
+        self.violations: List[LockdepError] = []
+        self.locks_created = 0
+        # strong refs to every tracked lock: graph edges key on id(lock),
+        # and a GC'd lock's recycled id would inherit stale edges (phantom
+        # cycles = flaky false inversions). Registries are per-test, so the
+        # retention is bounded by the test's lock population.
+        self._retained: List['TrackedLock'] = []
+
+    def retain(self, lock: 'TrackedLock') -> None:
+        with self._mu:
+            self._retained.append(lock)
+            self.locks_created += 1
+
+    # -- per-thread held stack -------------------------------------------------
+
+    def _held(self) -> List['TrackedLock']:
+        held = getattr(self._tls, 'held', None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def held_names(self) -> List[str]:
+        return [lock.name for lock in self._held()]
+
+    # -- graph -----------------------------------------------------------------
+
+    def _path_exists(self, src: int, dst: int) -> Optional[List[int]]:
+        """DFS: a path ``src -> ... -> dst`` in the edge set, as node ids."""
+        stack = [(src, [src])]
+        seen = set()
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self._edges.get(node, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def note_acquire(self, lock: 'TrackedLock') -> None:
+        """Called BEFORE the real acquire: record edges held → lock and
+        fail on a cycle."""
+        held = self._held()
+        if any(h is lock for h in held):
+            if lock.reentrant:
+                return      # RLock re-acquire: no self edges
+            # a plain Lock re-acquired by its holder blocks FOREVER — turn
+            # the silent hang into an immediate, named failure
+            error = SelfDeadlockError(
+                'self-deadlock: thread already holds non-reentrant lock '
+                '{!r} and is blocking on it again at {}'.format(
+                    lock.name, _site()))
+            with self._mu:
+                self.violations.append(error)
+            raise error
+        site = _site()
+        for h in held:
+            a, b = id(h), id(lock)
+            with self._mu:
+                self._names[a] = h.name
+                self._names[b] = lock.name
+                known = b in self._edges.get(a, ())
+                cycle = None if known else self._path_exists(b, a)
+                if cycle is None:
+                    self._edges.setdefault(a, set()).add(b)
+                    self._edge_sites.setdefault((a, b), site)
+                    continue
+                names = ' -> '.join(self._names.get(n, '?')
+                                    for n in cycle + [b])
+                forward = self._edge_sites.get((cycle[0], cycle[1]),
+                                               '<unknown>')
+                error = LockOrderInversionError(
+                    'lock-order inversion: acquiring {!r} while holding '
+                    '{!r} at {}, but the opposite order {} was taken at {} '
+                    '— two threads interleaving these paths deadlock'
+                    .format(lock.name, h.name, site, names, forward))
+                self.violations.append(error)
+            raise error
+
+    def push(self, lock: 'TrackedLock') -> None:
+        self._held().append(lock)
+
+    def pop(self, lock: 'TrackedLock') -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    # -- blocking guard --------------------------------------------------------
+
+    def check_blocking(self, what: str) -> None:
+        held = self.held_names()
+        if not held:
+            return
+        error = BlockingCallWhileLockedError(
+            '{} while holding tracked lock(s) {} at {} — blocking work '
+            'under a lock wedges every other acquirer (petalint R3, '
+            'enforced at runtime)'.format(what, held, _site()))
+        with self._mu:
+            self.violations.append(error)
+        raise error
+
+    # -- teardown --------------------------------------------------------------
+
+    def assert_clean(self) -> None:
+        """Raise the first recorded violation (worker funnels may have
+        swallowed the in-thread raise)."""
+        if self.violations:
+            raise self.violations[0]
+
+
+class TrackedLock:
+    """``threading.Lock`` with acquisition-graph bookkeeping."""
+
+    _factory = staticmethod(threading.Lock)
+    reentrant = False
+
+    def __init__(self, registry: LockdepRegistry,
+                 name: Optional[str] = None):
+        self._registry = registry
+        self._inner = self._factory()
+        self.name = name or '{}@{}'.format(type(self).__name__,
+                                           hex(id(self)))
+        registry.retain(self)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            # a non-blocking try-acquire cannot deadlock; only blocking
+            # acquisition orders enter the graph
+            self._registry.note_acquire(self)
+        got = (self._inner.acquire(blocking, timeout) if timeout != -1
+               else self._inner.acquire(blocking))
+        if got:
+            self._registry.push(self)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._registry.pop(self)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class TrackedRLock(TrackedLock):
+    """``threading.RLock`` variant: reentrant acquires push/pop pairwise,
+    and :meth:`LockdepRegistry.note_acquire` skips self-edges."""
+
+    _factory = staticmethod(threading.RLock)
+    reentrant = True
+
+
+class _ThreadingProxy:
+    """Stands in for a module's ``threading`` attribute: ``Lock``/``RLock``
+    become tracked constructors, everything else delegates."""
+
+    def __init__(self, registry: LockdepRegistry, modname: str):
+        self._registry = registry
+        self._modname = modname
+
+    def Lock(self):  # noqa: N802 - stdlib API shape
+        return TrackedLock(self._registry, name='Lock({})'.format(
+            self._modname))
+
+    def RLock(self):  # noqa: N802 - stdlib API shape
+        return TrackedRLock(self._registry, name='RLock({})'.format(
+            self._modname))
+
+    def __getattr__(self, name):
+        return getattr(threading, name)
+
+
+class _TimeProxy:
+    """Stands in for a module's ``time`` attribute: ``sleep`` checks the
+    blocking guard first, everything else delegates."""
+
+    def __init__(self, registry: LockdepRegistry):
+        self._registry = registry
+
+    def sleep(self, seconds):
+        self._registry.check_blocking('time.sleep({})'.format(seconds))
+        return time.sleep(seconds)
+
+    def __getattr__(self, name):
+        return getattr(time, name)
+
+
+@contextmanager
+def lockdep_enabled(modules=DEFAULT_TARGET_MODULES):
+    """Patch the target modules' ``threading``/``time`` attributes with
+    tracking proxies for the duration of the block; yields the
+    :class:`LockdepRegistry`. Locks created by those modules while active
+    are tracked; pre-existing locks are not (session-scoped fixtures stay
+    untouched). Restores the real modules on exit — the caller decides
+    whether to :meth:`~LockdepRegistry.assert_clean`."""
+    registry = LockdepRegistry()
+    patched = []
+    for modname in modules:
+        try:
+            mod = importlib.import_module(modname)
+        except ImportError:
+            continue
+        if getattr(mod, 'threading', None) is threading:
+            mod.threading = _ThreadingProxy(registry, modname)
+            patched.append((mod, 'threading', threading))
+        if getattr(mod, 'time', None) is time:
+            mod.time = _TimeProxy(registry)
+            patched.append((mod, 'time', time))
+    try:
+        yield registry
+    finally:
+        for mod, attr, original in patched:
+            setattr(mod, attr, original)

@@ -1,0 +1,44 @@
+"""Thread-leak probing shared by tests and the conftest teardown fixture.
+
+Every long-lived pipeline thread is named ``petastorm-tpu-*`` (enforced
+statically by petalint rule R5), which makes "did this reader tear down
+cleanly" a one-liner: enumerate live threads with the prefix. Promoted here
+from the ad-hoc helper in ``tests/test_tracing.py`` so the shutdown
+contract is checkable from any test lane (see the
+``no_dangling_petastorm_threads`` fixture in ``tests/conftest.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Sequence
+
+#: The thread-name prefix of every first-party pipeline thread.
+THREAD_NAME_PREFIX = 'petastorm-tpu-'
+
+
+def petastorm_threads() -> List[str]:
+    """Sorted names of live ``petastorm-tpu-*`` threads in this process."""
+    return sorted(t.name for t in threading.enumerate()
+                  if t.is_alive() and t.name.startswith(THREAD_NAME_PREFIX))
+
+
+def wait_for_no_new_threads(before: Sequence[str],
+                            timeout_s: float = 5.0) -> List[str]:
+    """Names of ``petastorm-tpu-*`` threads alive past ``timeout_s`` that
+    were not in ``before`` (multiset-aware: a pre-existing leak from an
+    earlier test is not re-billed to this one). Empty list = clean."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        budget = list(before)
+        leaked = []
+        for name in petastorm_threads():
+            if name in budget:
+                budget.remove(name)
+            else:
+                leaked.append(name)
+        if not leaked or time.monotonic() >= deadline:
+            return leaked
+        # daemons signalled by an earlier stop() may still be mid-exit
+        time.sleep(0.05)

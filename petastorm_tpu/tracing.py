@@ -319,11 +319,17 @@ class MetricsEmitter:
 
     def emit_once(self) -> None:
         snapshot = dict(self._snapshot_fn())
+        if self._fmt == 'jsonl':
+            # deliberate wall clock: 'ts' is a log-pipeline timestamp for
+            # humans and scrapers, never compared against monotonic readings
+            ts = time.time()  # petalint: disable=monotonic-clock
+            line = json.dumps({'ts': ts, **snapshot}, sort_keys=True)
+        # _emit_lock exists precisely to serialize emissions (periodic tick
+        # vs the final flush at stop()); holding it across the write IS the
+        # point, and only those two threads ever contend on it
         with self._emit_lock:
             if self._fmt == 'jsonl':
-                line = json.dumps({'ts': time.time(), **snapshot},
-                                  sort_keys=True)
-                with open(self._path, 'a') as f:
+                with open(self._path, 'a') as f:  # petalint: disable=lock-discipline
                     f.write(line + '\n')
             else:
                 self._write_prometheus(snapshot)

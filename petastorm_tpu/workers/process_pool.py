@@ -27,6 +27,7 @@ from __future__ import annotations
 import logging
 import os
 import pickle
+import subprocess
 import threading
 import time
 import traceback
@@ -362,14 +363,17 @@ class ProcessPool:
                     _, control = self._recv_multipart()
                     if isinstance(control, _WorkerTerminated):
                         self._terminated_workers += 1
-                except Exception:  # socket closing under us mid-drain
+                # teardown drain: ANY failure here means the transport is
+                # closing under us, which is the condition being handled —
+                # swallowing OSError is the intended semantics
+                except Exception:  # petalint: disable=exception-hygiene
                     break
 
     def join(self):
         for proc in self._processes:
             try:
                 proc.wait(timeout=_SHUTDOWN_TIMEOUT_S)
-            except Exception:
+            except subprocess.TimeoutExpired:
                 proc.kill()
         for sock in (self._work_sender, self._control_sender, self._results_receiver):
             if sock is not None:
@@ -416,7 +420,8 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
                 os._exit(0)
             time.sleep(1)
 
-    threading.Thread(target=monitor_parent, daemon=True).start()
+    threading.Thread(target=monitor_parent, daemon=True,
+                     name='petastorm-tpu-parent-monitor').start()
 
     serializer = as_multipart(serializer)
     context = zmq.Context()
@@ -483,6 +488,12 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
 
     try:
         worker = worker_class(worker_id, publish, worker_args)
+    except (OSError, MemoryError) as e:
+        # infra failure (NEVER_QUARANTINE class): ship it, then die loudly —
+        # a nonzero child exit reaches the parent's liveness check even when
+        # the error frame is lost in a closing transport
+        send([b''], _WorkerError(e, traceback.format_exc()))
+        raise
     except Exception as e:
         send([b''], _WorkerError(e, traceback.format_exc()))
         return
@@ -577,6 +588,13 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
             process_start = time.perf_counter()
             try:
                 worker.process(*args, **kwargs)
+            except (OSError, MemoryError) as e:
+                # infra failure (NEVER_QUARANTINE class): ship it, then stop
+                # serving from a broken resource — the raise runs the full
+                # teardown path below (terminated frame, socket close) and
+                # exits the child nonzero for the parent's liveness check
+                send([b''], _WorkerError(e, traceback.format_exc()))
+                raise
             except Exception as e:
                 send([b''], _WorkerError(e, traceback.format_exc()))
             elapsed = time.perf_counter() - process_start

@@ -99,6 +99,12 @@ class WorkerThread(threading.Thread):
                 start = time.perf_counter()
                 try:
                     self._worker.process(*args, **kwargs)
+                except (OSError, MemoryError) as e:
+                    # infra failure (NEVER_QUARANTINE class): ship it, then
+                    # stop serving from a broken resource — the consumer
+                    # re-raises the shipped exception and stops the pool
+                    self._pool._put_result(_WorkerException(e))
+                    raise
                 except Exception as e:  # ship to consumer; keep serving
                     logger.debug('Worker %s raised:\n%s', self._worker.worker_id,
                                  traceback.format_exc())

@@ -24,6 +24,67 @@ reassert_cpu_platform()
 
 import pytest  # noqa: E402
 
+#: Test modules covered by the ``no_dangling_petastorm_threads`` teardown
+#: fixture — the reader-lifecycle lanes, where every test constructs (and
+#: must fully tear down) pools/watchdogs/emitters/readahead threads. A
+#: leaked ``petastorm-tpu-*`` thread fails the LEAKING test, not whichever
+#: later test happened to enumerate threads (the PR 4 assertion in
+#: test_tracing, promoted to a shared guard).
+_THREAD_GUARDED_MODULES = frozenset({
+    'test_tracing', 'test_health', 'test_sharedcache', 'test_readahead',
+    'test_workers_pool', 'test_transport',
+})
+
+#: Test modules that run under the lockdep-lite harness
+#: (``petastorm_tpu.test_util.lockdep``) when ``PETASTORM_TPU_LOCKDEP=1``:
+#: the lanes exercising the concurrency-critical modules' real lock
+#: interleavings. Opt-in because the harness is a diagnostic, not a
+#: production layer; ``ci/run_tests.sh`` runs these lanes with it on.
+_LOCKDEP_MODULES = frozenset({
+    'test_sharedcache', 'test_health', 'test_workers_pool',
+})
+
+
+def _short_module_name(request) -> str:
+    return request.module.__name__.rsplit('.', 1)[-1]
+
+
+@pytest.fixture(autouse=True)
+def no_dangling_petastorm_threads(request):
+    """Teardown guard for the reader-lifecycle lanes: any ``petastorm-tpu-*``
+    thread the test leaves behind (beyond a settle window for daemons
+    mid-exit) fails the test itself."""
+    if _short_module_name(request) not in _THREAD_GUARDED_MODULES:
+        yield
+        return
+    from petastorm_tpu.test_util.threads import (petastorm_threads,
+                                                 wait_for_no_new_threads)
+    before = petastorm_threads()
+    yield
+    leaked = wait_for_no_new_threads(before)
+    assert not leaked, (
+        'test leaked petastorm-tpu threads: {} (Reader.stop()/join() — or '
+        'the component\'s own stop() — must reap every thread it '
+        'started)'.format(leaked))
+
+
+@pytest.fixture(autouse=True)
+def lockdep_guard(request):
+    """Opt-in lockdep-lite harness (PETASTORM_TPU_LOCKDEP=1): tracks every
+    lock the concurrency-critical modules create during the test, fails on
+    lock-order inversion cycles and on blocking calls under a tracked lock
+    — including violations raised on worker threads and swallowed by their
+    exception funnels (re-raised here at teardown)."""
+    enabled = os.environ.get('PETASTORM_TPU_LOCKDEP', '').strip().lower()
+    if (_short_module_name(request) not in _LOCKDEP_MODULES
+            or enabled in ('', '0', 'false', 'off')):
+        yield
+        return
+    from petastorm_tpu.test_util import lockdep
+    with lockdep.lockdep_enabled() as registry:
+        yield registry
+    registry.assert_clean()
+
 
 # old-style hookwrapper (works on all pytest 7.x): this fallback exists
 # precisely for bare environments that may predate pluggy 1.2's wrapper=True

@@ -1,0 +1,534 @@
+"""Tests for the petalint static checker (``ci/analysis``) and the
+lockdep-lite runtime harness (``petastorm_tpu.test_util.lockdep``).
+
+Per rule: a known-bad fixture snippet must FAIL, the same snippet with an
+inline suppression must pass, and a baseline-matched finding must be
+reported without failing. The baseline may only shrink: an entry whose
+referenced line no longer matches is itself an error. The lockdep tests
+construct a real A→B / B→A lock-order inversion across two threads and
+assert it is detected within the run (no deadlock interleaving needed).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from ci.analysis import analyze_paths
+from ci.analysis.engine import Baseline, Suppressions
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_fixture(root, relpath, source):
+    full = root / relpath
+    full.parent.mkdir(parents=True, exist_ok=True)
+    full.write_text(textwrap.dedent(source))
+    return relpath
+
+
+def findings_for(root, relpath):
+    return analyze_paths([str(relpath)], str(root))
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def run_cli(root, *args):
+    """Run ``python -m ci.analysis`` as CI does; returns (exit, stdout)."""
+    proc = subprocess.run(
+        [sys.executable, '-m', 'ci.analysis', '--root', str(root), *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+# -- one known-bad fixture per rule -------------------------------------------
+
+BAD_R1 = '''
+    import json
+
+    def dump_bench(path, blob):
+        with open(path, 'w') as f:
+            json.dump(blob, f)
+'''
+
+BAD_R2 = '''
+    import time
+
+    def lock_age(mtime):
+        return time.time() - mtime
+'''
+
+BAD_R3 = '''
+    def drain(lock, work_queue, item):
+        with lock:
+            work_queue.put(item)
+'''
+
+BAD_R4 = '''
+    def process(worker, item):
+        try:
+            worker.decode(item)
+        except Exception:
+            pass
+'''
+
+BAD_R5 = '''
+    import threading
+
+    def start(fn):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        return t
+'''
+
+BAD_R6 = '''
+    import threading
+
+    def noop():
+        pass
+
+    threading.Thread(target=noop, name='petastorm-tpu-eager').start()
+'''
+
+RULE_FIXTURES = [
+    ('atomic-publish', 'petastorm_tpu/bad_r1.py', BAD_R1),
+    ('monotonic-clock', 'petastorm_tpu/workers/bad_r2.py', BAD_R2),
+    ('lock-discipline', 'petastorm_tpu/bad_r3.py', BAD_R3),
+    ('exception-hygiene', 'petastorm_tpu/workers/bad_r4.py', BAD_R4),
+    ('thread-lifecycle', 'petastorm_tpu/bad_r5.py', BAD_R5),
+    ('kill-switch', 'petastorm_tpu/bad_r6.py', BAD_R6),
+]
+
+
+class TestRules:
+    @pytest.mark.parametrize('rule,relpath,source', RULE_FIXTURES,
+                             ids=[r for r, _, _ in RULE_FIXTURES])
+    def test_known_bad_fixture_fails(self, tmp_path, rule, relpath, source):
+        write_fixture(tmp_path, relpath, source)
+        findings = findings_for(tmp_path, relpath)
+        assert rule in rules_of(findings), \
+            'expected a {} finding, got {}'.format(rule, findings)
+
+    @pytest.mark.parametrize('rule,relpath,source', RULE_FIXTURES,
+                             ids=[r for r, _, _ in RULE_FIXTURES])
+    def test_cli_exits_nonzero_on_fixture(self, tmp_path, rule, relpath,
+                                          source):
+        write_fixture(tmp_path, relpath, source)
+        code, out = run_cli(tmp_path, relpath)
+        assert code == 1, out
+        assert rule in out
+
+    @pytest.mark.parametrize('rule,relpath,source', RULE_FIXTURES,
+                             ids=[r for r, _, _ in RULE_FIXTURES])
+    def test_inline_suppression_silences(self, tmp_path, rule, relpath,
+                                         source):
+        lines = textwrap.dedent(source).splitlines()
+        suppressed = '\n'.join(
+            '{}  # petalint: disable={}'.format(line, rule) if line.strip()
+            else line for line in lines)
+        (tmp_path / relpath).parent.mkdir(parents=True, exist_ok=True)
+        (tmp_path / relpath).write_text(suppressed + '\n')
+        findings = findings_for(tmp_path, relpath)
+        assert rule not in rules_of(findings), findings
+
+    def test_out_of_scope_path_not_flagged(self, tmp_path):
+        # R2 is scoped to the concurrency-critical modules; the identical
+        # wall-clock call elsewhere is legal
+        rel = write_fixture(tmp_path, 'petastorm_tpu/etl/ok.py', BAD_R2)
+        assert 'monotonic-clock' not in rules_of(findings_for(tmp_path, rel))
+
+    def test_atomic_publish_accepts_tmp_replace_and_touch(self, tmp_path):
+        rel = write_fixture(tmp_path, 'petastorm_tpu/ok_r1.py', '''
+            import os
+
+            def publish(path, text):
+                tmp = path + '.tmp'
+                with open(tmp, 'w') as f:
+                    f.write(text)
+                os.replace(tmp, path)
+
+            def touch(path):
+                with open(path, 'w'):
+                    pass
+
+            def append_line(path, line):
+                with open(path, 'a') as f:
+                    f.write(line)
+        ''')
+        assert findings_for(tmp_path, rel) == []
+
+    def test_exception_hygiene_accepts_reraise_forms(self, tmp_path):
+        rel = write_fixture(tmp_path, 'petastorm_tpu/readers/ok_r4.py', '''
+            def policy_funnel(worker, item):
+                try:
+                    worker.decode(item)
+                except Exception as e:
+                    if not worker.quarantine(e):
+                        raise
+
+            def siphon_first(worker, item):
+                try:
+                    worker.decode(item)
+                except (OSError, MemoryError):
+                    raise
+                except Exception:
+                    worker.note_bad_sample(item)
+        ''')
+        assert findings_for(tmp_path, rel) == []
+
+    def test_lock_discipline_flags_bare_acquire(self, tmp_path):
+        rel = write_fixture(tmp_path, 'petastorm_tpu/bad_acquire.py', '''
+            def unsafe(lock):
+                lock.acquire()
+                do_work()
+                lock.release()
+        ''')
+        assert 'lock-discipline' in rules_of(findings_for(tmp_path, rel))
+
+    def test_lock_discipline_ignores_dict_get_and_cv_wait(self, tmp_path):
+        rel = write_fixture(tmp_path, 'petastorm_tpu/ok_r3.py', '''
+            def fine(lock, records, cv):
+                with lock:
+                    value = records.get('items', 0)
+                with cv:
+                    cv.wait(timeout=0.1)
+                return value
+        ''')
+        assert findings_for(tmp_path, rel) == []
+
+    def test_thread_lifecycle_requires_join_for_self_threads(self, tmp_path):
+        rel = write_fixture(tmp_path, 'petastorm_tpu/bad_join.py', '''
+            import threading
+
+            class Leaky:
+                def start(self):
+                    self._thread = threading.Thread(
+                        target=self._run, daemon=True,
+                        name='petastorm-tpu-leaky')
+                    self._thread.start()
+        ''')
+        findings = findings_for(tmp_path, rel)
+        assert ['thread-lifecycle'] == rules_of(findings)
+        assert 'never join()ed' in findings[0].message
+
+    def test_thread_lifecycle_unrelated_join_does_not_vouch(self, tmp_path):
+        # `sep.join(parts)` is a string join, not the thread's: still leaky
+        rel = write_fixture(tmp_path, 'petastorm_tpu/bad_join2.py', '''
+            import threading
+
+            class StillLeaky:
+                def start(self):
+                    self._thread = threading.Thread(
+                        target=self._run, daemon=True,
+                        name='petastorm-tpu-leaky')
+                    self._thread.start()
+
+                def label(self, sep, parts):
+                    return sep.join(parts)
+        ''')
+        assert 'thread-lifecycle' in rules_of(findings_for(tmp_path, rel))
+
+    def test_thread_lifecycle_accepts_alias_join(self, tmp_path):
+        # the idempotent-stop pattern: snapshot self._thread to a local
+        # under a lock, join the local outside it
+        rel = write_fixture(tmp_path, 'petastorm_tpu/ok_join.py', '''
+            import threading
+
+            class Clean:
+                def start(self):
+                    self._thread = threading.Thread(
+                        target=self._run, daemon=True,
+                        name='petastorm-tpu-clean')
+                    self._thread.start()
+
+                def stop(self):
+                    thread = self._thread
+                    self._thread = None
+                    if thread is not None:
+                        thread.join(timeout=5)
+        ''')
+        assert findings_for(tmp_path, rel) == []
+
+    def test_thread_lifecycle_accepts_swap_alias_join(self, tmp_path):
+        # the swap form: `thread, self._thread = self._thread, None`
+        rel = write_fixture(tmp_path, 'petastorm_tpu/ok_join2.py', '''
+            import threading
+
+            class CleanSwap:
+                def start(self):
+                    self._thread = threading.Thread(
+                        target=self._run, daemon=True,
+                        name='petastorm-tpu-clean')
+                    self._thread.start()
+
+                def stop(self):
+                    thread, self._thread = self._thread, None
+                    if thread is not None:
+                        thread.join(timeout=5)
+        ''')
+        assert findings_for(tmp_path, rel) == []
+
+    def test_kill_switch_flags_default_args_and_decorators(self, tmp_path):
+        # default-argument values and decorator expressions of a
+        # module-level def execute AT IMPORT — R6 must see them
+        rel = write_fixture(tmp_path, 'petastorm_tpu/bad_r6b.py', '''
+            import tempfile
+
+            def start(path, fh=open('/tmp/state', 'w')):
+                return fh
+
+            @print(tempfile.mkdtemp())
+            def decorated():
+                pass
+        ''')
+        findings = [f for f in findings_for(tmp_path, rel)
+                    if f.rule == 'kill-switch']
+        assert len(findings) == 2, findings
+
+    def test_kill_switch_ignores_function_bodies(self, tmp_path):
+        rel = write_fixture(tmp_path, 'petastorm_tpu/ok_r6.py', '''
+            import threading
+
+            def start():
+                t = threading.Thread(target=start,
+                                     name='petastorm-tpu-later')
+                t.start()
+                t.join()
+                return open('/tmp/state', 'w')    # runtime, not import
+        ''')
+        assert 'kill-switch' not in rules_of(findings_for(tmp_path, rel))
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        rel = write_fixture(tmp_path, 'petastorm_tpu/broken.py',
+                            'def oops(:\n')
+        assert rules_of(findings_for(tmp_path, rel)) == ['parse-error']
+
+
+class TestSuppressionForms:
+    def test_directive_inside_string_literal_is_data(self, tmp_path):
+        # the directive text in a string/docstring must not register a
+        # suppression — only real comment tokens do
+        rel = write_fixture(tmp_path, 'petastorm_tpu/workers/strlit.py', '''
+            import time
+
+            def age(mtime):
+                return time.time() - mtime, 'see # petalint: disable=monotonic-clock'
+        ''')
+        assert 'monotonic-clock' in rules_of(findings_for(tmp_path, rel))
+
+    def test_standalone_comment_covers_next_line(self):
+        sup = Suppressions(['# petalint: disable=monotonic-clock',
+                            't = time.time()'])
+        fake = type('F', (), {'line': 2, 'rule': 'monotonic-clock'})()
+        assert sup.suppressed(fake)
+
+    def test_disable_file_and_all(self):
+        sup = Suppressions(['# petalint: disable-file=kill-switch',
+                            'x = 1',
+                            'y = 2  # petalint: disable=all'])
+        assert sup.suppressed(type('F', (), {'line': 99,
+                                             'rule': 'kill-switch'})())
+        assert sup.suppressed(type('F', (), {'line': 3,
+                                             'rule': 'anything'})())
+        assert not sup.suppressed(type('F', (), {'line': 2,
+                                                 'rule': 'anything'})())
+
+
+class TestBaseline:
+    def _baseline_for(self, tmp_path, relpath):
+        findings = findings_for(tmp_path, relpath)
+        blob = {'version': 1,
+                'findings': [f.baseline_entry() for f in findings]}
+        baseline = tmp_path / 'baseline.json'
+        baseline.write_text(json.dumps(blob))
+        return baseline
+
+    def test_baselined_finding_does_not_fail(self, tmp_path):
+        rel = write_fixture(tmp_path, 'petastorm_tpu/bad_r1.py', BAD_R1)
+        baseline = self._baseline_for(tmp_path, rel)
+        code, out = run_cli(tmp_path, '--baseline', str(baseline), rel)
+        assert code == 0, out
+        assert '(baselined)' in out
+
+    def test_stale_baseline_entry_fails(self, tmp_path):
+        rel = write_fixture(tmp_path, 'petastorm_tpu/bad_r1.py', BAD_R1)
+        baseline = self._baseline_for(tmp_path, rel)
+        # fix the finding: the baseline entry's line no longer matches and
+        # must be deleted — the baseline can only shrink
+        (tmp_path / rel).write_text('GONE = True\n')
+        code, out = run_cli(tmp_path, '--baseline', str(baseline), rel)
+        assert code == 1
+        assert 'stale' in out
+
+    def test_moved_finding_is_new_and_entry_stale(self, tmp_path):
+        rel = write_fixture(tmp_path, 'petastorm_tpu/bad_r1.py', BAD_R1)
+        baseline = self._baseline_for(tmp_path, rel)
+        # shift every line down: same violation, different location — the
+        # entry must not silently re-bind to it
+        src = (tmp_path / rel).read_text()
+        (tmp_path / rel).write_text('# a new first line\n' + src)
+        findings = findings_for(tmp_path, rel)
+        new, baselined, stale = Baseline.load(str(baseline)).split(findings)
+        assert new and stale and not baselined
+
+    def test_write_baseline_round_trips(self, tmp_path):
+        rel = write_fixture(tmp_path, 'petastorm_tpu/bad_r1.py', BAD_R1)
+        out_path = tmp_path / 'generated.json'
+        code, _ = run_cli(tmp_path, '--write-baseline', '--baseline',
+                          str(out_path), rel)
+        assert code == 0
+        code, out = run_cli(tmp_path, '--baseline', str(out_path), rel)
+        assert code == 0, out
+
+
+class TestRepoIsClean:
+    def test_first_party_code_passes_with_committed_baseline(self):
+        """The acceptance gate: ``python -m ci.analysis`` exits 0 on the
+        repo, and the committed baseline carries no first-party entries."""
+        code, out = run_cli(REPO_ROOT)
+        assert code == 0, out
+        with open(os.path.join(REPO_ROOT, 'ci', 'analysis',
+                               'baseline.json')) as f:
+            assert json.load(f)['findings'] == []
+
+
+# -- lockdep-lite -------------------------------------------------------------
+
+
+class TestLockdep:
+    def _run_in_thread(self, fn):
+        errors = []
+
+        def runner():
+            try:
+                fn()
+            except Exception as e:  # collected for assertion
+                errors.append(e)
+
+        t = threading.Thread(target=runner, name='petastorm-tpu-lockdep-test')
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive(), 'lockdep test thread wedged'
+        return errors
+
+    def test_ab_ba_inversion_detected_across_threads(self):
+        from petastorm_tpu.test_util.lockdep import (LockdepRegistry,
+                                                     LockOrderInversionError,
+                                                     TrackedLock)
+        registry = LockdepRegistry()
+        a = TrackedLock(registry, name='A')
+        b = TrackedLock(registry, name='B')
+
+        def forward():    # A -> B
+            with a:
+                with b:
+                    pass
+
+        def inverted():   # B -> A: closes the cycle
+            with b:
+                with a:
+                    pass
+
+        assert self._run_in_thread(forward) == []
+        errors = self._run_in_thread(inverted)
+        assert len(errors) == 1
+        assert isinstance(errors[0], LockOrderInversionError)
+        assert "'A'" in str(errors[0]) and "'B'" in str(errors[0])
+        with pytest.raises(LockOrderInversionError):
+            registry.assert_clean()   # the teardown backstop sees it too
+
+    def test_consistent_order_and_reentrancy_stay_clean(self):
+        from petastorm_tpu.test_util.lockdep import (LockdepRegistry,
+                                                     TrackedLock,
+                                                     TrackedRLock)
+        registry = LockdepRegistry()
+        a = TrackedLock(registry, name='A')
+        r = TrackedRLock(registry, name='R')
+
+        def ordered():
+            for _ in range(50):
+                with a:
+                    with r:
+                        with r:      # reentrant re-acquire: no self edge
+                            pass
+
+        for _ in range(2):
+            assert self._run_in_thread(ordered) == []
+        registry.assert_clean()
+
+    def test_blocking_call_while_locked_raises(self):
+        from petastorm_tpu.test_util.lockdep import (
+            BlockingCallWhileLockedError, LockdepRegistry, TrackedLock,
+            _TimeProxy)
+        registry = LockdepRegistry()
+        lock = TrackedLock(registry, name='L')
+        proxy = _TimeProxy(registry)
+        proxy.sleep(0)                # not holding anything: fine
+        with lock:
+            with pytest.raises(BlockingCallWhileLockedError):
+                proxy.sleep(0.01)
+        with pytest.raises(BlockingCallWhileLockedError):
+            registry.assert_clean()
+
+    def test_self_deadlock_on_nonreentrant_lock_raises(self):
+        # re-acquiring a held plain Lock blocks forever: the harness must
+        # name it immediately instead of hanging the lane to the timeout
+        from petastorm_tpu.test_util.lockdep import (LockdepRegistry,
+                                                     SelfDeadlockError,
+                                                     TrackedLock)
+        registry = LockdepRegistry()
+        lock = TrackedLock(registry, name='L')
+        with lock:
+            with pytest.raises(SelfDeadlockError):
+                lock.acquire()
+        with pytest.raises(SelfDeadlockError):
+            registry.assert_clean()
+
+    def test_registry_retains_locks_against_id_reuse(self):
+        # graph edges key on id(lock); a GC'd lock's recycled id would
+        # inherit stale edges (phantom cycles), so the registry must hold
+        # every tracked lock it has seen
+        import gc
+        from petastorm_tpu.test_util.lockdep import (LockdepRegistry,
+                                                     TrackedLock)
+        registry = LockdepRegistry()
+        lock = TrackedLock(registry, name='ephemeral')
+        ref_id = id(lock)
+        del lock
+        gc.collect()
+        assert any(id(kept) == ref_id for kept in registry._retained)
+
+    def test_try_acquire_does_not_enter_the_graph(self):
+        from petastorm_tpu.test_util.lockdep import (LockdepRegistry,
+                                                     TrackedLock)
+        registry = LockdepRegistry()
+        a = TrackedLock(registry, name='A')
+        b = TrackedLock(registry, name='B')
+        with a:
+            with b:
+                pass
+        with b:
+            assert a.acquire(blocking=False)   # trylock cannot deadlock
+            a.release()
+        registry.assert_clean()
+
+    def test_enabled_context_patches_and_restores_modules(self):
+        import petastorm_tpu.workers.stats as stats_mod
+        from petastorm_tpu.test_util.lockdep import (TrackedLock,
+                                                     lockdep_enabled)
+        real_threading = stats_mod.threading
+        with lockdep_enabled() as registry:
+            stats = stats_mod.ReaderStats()
+            assert isinstance(stats._lock, TrackedLock)
+            stats.add('items_out')            # tracked lock in real use
+            assert registry.locks_created >= 1
+        assert stats_mod.threading is real_threading
+        registry.assert_clean()
+        # locks created after restore are raw again
+        assert not isinstance(stats_mod.ReaderStats()._lock, TrackedLock)

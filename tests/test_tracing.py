@@ -299,10 +299,10 @@ class TestMetricsEmitter:
         assert len(lines) == emitter.emit_count
 
 
-def _petastorm_threads():
-    import threading
-    return sorted(t.name for t in threading.enumerate()
-                  if t.is_alive() and t.name.startswith('petastorm-tpu-'))
+# promoted to petastorm_tpu.test_util.threads (and a conftest teardown
+# fixture over every reader-lifecycle lane); the in-test assertions below
+# stay because they check the state mid-test, right after join()
+from petastorm_tpu.test_util.threads import petastorm_threads as _petastorm_threads  # noqa: E402,E501
 
 
 class TestReaderShutdownLifecycle:
