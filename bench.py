@@ -294,6 +294,16 @@ def main(argv=None):
     roofline_bench.pop('attribution', None)
     roofline_bench.pop('probes', None)
 
+    # -- batched decode: vectorized vs per-cell codec decode ----------------
+    # Quick mode asserts bit-identity + the path-split counters; the
+    # headline roofline record lives in BENCH_r13.json from the full run.
+    from petastorm_tpu.benchmark.decode_batch import run_decode_batch_bench
+    decode_batch = run_decode_batch_bench(quick=True)
+    # per-run detail is artifact material, not headline JSON
+    for line in decode_batch.get('lines', {}).values():
+        line.pop('runs', None)
+        line.pop('roofline', None)
+
     # -- north-star: train-step infeed overlap ------------------------------
     # Accelerator-scale configs for any non-CPU backend; dataset paths carry
     # the size parameters so a platform change can't reuse a stale store.
@@ -482,6 +492,7 @@ def main(argv=None):
         'lineage_overhead': lineage_overhead,
         'shared_cache': shared_cache,
         'roofline_bench': roofline_bench,
+        'decode_batch': decode_batch,
         'northstar': {
             'platform': platform,
             'mnist_train': _with_roofline(mnist.as_dict(), mnist_roofline),

@@ -168,6 +168,10 @@ def _bench_summary_entries(artifact, round_no, parsed):
         if isinstance(roofline, dict) and roofline.get('benchmark'):
             entries.extend(_roofline_entries(artifact, round_no, roofline))
             break
+    decode_batch = parsed.get('decode_batch')
+    if isinstance(decode_batch, dict) and decode_batch.get('benchmark'):
+        entries.extend(_decode_batch_entries(artifact, round_no,
+                                             decode_batch))
     return entries
 
 
@@ -183,6 +187,32 @@ def _roofline_entries(artifact, round_no, blob):
     return [_entry(artifact, round_no,
                    blob.get('benchmark', 'roofline_mnist_decode'),
                    config, sps, roofline_pct=roof.get('roofline_pct'))]
+
+
+def _decode_batch_entries(artifact, round_no, blob):
+    """Entries from a ``benchmark/decode_batch.py`` result (r13): one series
+    per measured line (workers x batched/percell are distinct configs —
+    like-for-like gating), roofline context on the lines that carry it."""
+    entries = []
+    for name, line in (blob.get('lines') or {}).items():
+        sps = line.get('samples_per_sec')
+        if not isinstance(sps, (int, float)):
+            continue
+        config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+                  'workers': line.get('workers'), 'rows': blob.get('rows')}
+        entries.append(_entry(artifact, round_no,
+                              'decode_batch.{}'.format(name), config, sps,
+                              roofline_pct=line.get('roofline_pct')))
+    for name, entry in (blob.get('column_decode') or {}).items():
+        sps = entry.get('batched_rows_per_s')
+        if not isinstance(sps, (int, float)):
+            continue
+        config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+                  'rows': entry.get('rows')}
+        entries.append(_entry(artifact, round_no,
+                              'decode_batch.column.{}'.format(name), config,
+                              sps))
+    return entries
 
 
 def _overhead_entries(artifact, round_no, blob):
@@ -241,6 +271,8 @@ def normalize_artifact(name: str, blob: dict):
         entries.extend(_bench_summary_entries(name, round_no, payload))
     elif payload.get('benchmark', '').startswith('roofline'):
         entries.extend(_roofline_entries(name, round_no, payload))
+    elif payload.get('benchmark', '').startswith('decode_batch'):
+        entries.extend(_decode_batch_entries(name, round_no, payload))
     elif 'baseline_items_per_s' in payload:
         entries.extend(_overhead_entries(name, round_no, payload))
     elif 'shared' in payload and 'roofline' in payload:

@@ -74,6 +74,12 @@ python -m pytest tests/test_profiler.py -q
 echo '== roofline quick bench (calibrated ceilings + attribution on the mnist decode line) =='
 python -m petastorm_tpu.benchmark.roofline --quick
 
+echo '== batched-decode quick bench (vectorized vs per-cell codec decode, bit-identity) =='
+python -m petastorm_tpu.benchmark.decode_batch --quick
+
+echo '== batched-decode quick checks (bit-identity property tests, quarantine, lineage audit) =='
+python -m pytest tests/test_decode_batch.py -q
+
 echo '== bench-docs consistency gate =='
 python ci/check_bench_docs.py
 

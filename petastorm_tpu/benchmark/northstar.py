@@ -53,21 +53,27 @@ def generate_mnist_images_dataset(output_url: str, rows: int = 16384,
     return output_url
 
 
-def make_token_schema(seq_len: int) -> Unischema:
-    # arrow_list: token windows decode vectorized in C++ (no per-row np.load)
+def make_token_schema(seq_len: int, ndarray_codec: bool = False) -> Unischema:
+    # arrow_list: token windows decode vectorized in C++ (no per-row
+    # np.load). ndarray_codec=True stores np.save payloads instead — the
+    # opaque-bytes layout the batched-decode bench A/Bs its vectorized
+    # chunk decode against (benchmark/decode_batch.py).
+    from petastorm_tpu.codecs import NdarrayCodec
+    codec = NdarrayCodec() if ndarray_codec else ArrowListCodec()
     return Unischema('TokenSchema', [
-        UnischemaField('tokens', np.int32, (seq_len + 1,), ArrowListCodec(), False),
+        UnischemaField('tokens', np.int32, (seq_len + 1,), codec, False),
     ])
 
 
 def generate_token_dataset(output_url: str, rows: int = 2048,
                            seq_len: int = 256, vocab: int = 8192,
                            seed: int = 0,
-                           row_group_size_mb: float = 4.0) -> str:
+                           row_group_size_mb: float = 4.0,
+                           ndarray_codec: bool = False) -> str:
     """LM token windows: each row holds seq_len+1 tokens (input + shifted
     target), the shape the NGram pipeline emits for next-token training."""
     rng = np.random.default_rng(seed)
-    schema = make_token_schema(seq_len)
+    schema = make_token_schema(seq_len, ndarray_codec=ndarray_codec)
 
     def gen():
         for _ in range(rows):

@@ -15,12 +15,47 @@ from __future__ import annotations
 
 import inspect
 import io
+import os
 import re
 from abc import ABC, abstractmethod
+from itertools import repeat
 from typing import Any, Dict, Type
 
 import numpy as np
 import pyarrow as pa
+
+#: Environment variable gating the row-group-vectorized (batched) decode
+#: path (default on). ``0``/``false``/``off`` forces every codec column
+#: through the per-cell loop — the uniform observability/behavior kill
+#: switch shape (``PETASTORM_TPU_HEALTH``, ``PETASTORM_TPU_LINEAGE``,
+#: ``PETASTORM_TPU_PROFILER``). The two paths are bit-identical by
+#: contract (``docs/decode.md``); the switch exists for A/B measurement
+#: (``benchmark/decode_batch.py``) and as an escape hatch.
+BATCHED_DECODE_ENV_VAR = 'PETASTORM_TPU_BATCHED_DECODE'
+
+
+def batched_decode_enabled() -> bool:
+    """The :data:`BATCHED_DECODE_ENV_VAR` gate (default on). Read once per
+    worker at construction, never per cell."""
+    value = os.environ.get(BATCHED_DECODE_ENV_VAR, '').strip().lower()
+    return value not in ('0', 'false', 'off')
+
+
+def split_binary_chunk(chunk: pa.Array):
+    """``(offsets, data)`` of one (large_)binary arrow chunk: the int
+    offsets vector and the shared ``uint8`` data buffer, both zero-copy.
+    Cell ``i`` is ``data[offsets[i]:offsets[i + 1]]`` — the one
+    buffer-splitting primitive under every batched decoder and the
+    per-cell view builder."""
+    n = len(chunk)
+    _validity, offsets_buf, data_buf = chunk.buffers()
+    off_dtype = np.dtype(
+        np.int64 if pa.types.is_large_binary(chunk.type) else np.int32)
+    offsets = np.frombuffer(offsets_buf, dtype=off_dtype, count=n + 1,
+                            offset=chunk.offset * off_dtype.itemsize)
+    data = (np.frombuffer(data_buf, dtype=np.uint8)
+            if data_buf is not None else np.empty(0, np.uint8))
+    return offsets, data
 
 
 class DataframeColumnCodec(ABC):
@@ -55,6 +90,22 @@ class DataframeColumnCodec(ABC):
                 unischema_field,
                 cell.tobytes() if isinstance(cell, np.ndarray) else cell)
         return decode_cell
+
+    def make_column_decoder(self, unischema_field):
+        """Return ``decode_chunk(chunk: pa.Array) -> Optional[np.ndarray]``
+        decoding one null-free (large_)binary column chunk in a single
+        shot, or ``None`` when this codec has no vectorized path.
+
+        Contract (``docs/decode.md``): the reader calls the returned
+        callable only for fixed-shape fields on null-free chunks, with no
+        per-field decode override in play. The callable returns the decoded
+        ``(len(chunk), *shape)`` array **bit-identical** to what the
+        per-cell loop produces for the same chunk, or ``None`` to punt a
+        chunk it cannot vectorize; it may also raise on corrupt data — the
+        reader then retries the column per cell, so quarantine row offsets
+        and error semantics are exactly the per-cell loop's. Never return
+        an approximation."""
+        return None
 
     @abstractmethod
     def arrow_type(self, unischema_field) -> pa.DataType:
@@ -141,17 +192,11 @@ _NPY_FAST_HEADER = re.compile(
     rb"'shape': \((\d*(?:, ?\d+)*,?)\), \}\s*$")
 
 
-def _fast_npy_decode(value):
-    """Decode an ``np.save`` payload without ast-based header parsing;
-    returns None when the payload is not in the standard v1 form.
-    ``value`` may be ``bytes`` or any buffer-protocol object (the columnar
-    reader passes zero-copy uint8 ndarray views).
-
-    Returns a WRITABLE array (one memcpy), matching what ``np.load`` gives
-    consumers on the fallback path — an in-place transform must not work for
-    one serialization form and crash for another."""
-    if isinstance(value, np.ndarray):
-        value = memoryview(value)
+def _parse_fast_npy_header(value):
+    """``(dtype, shape, header_end)`` of a standard-form ``np.save`` v1
+    payload prefix, or ``None`` when the header is not machine-generated
+    v1 (fortran order, object dtype, hand-crafted). ``value`` is any
+    sliceable buffer (bytes or memoryview)."""
     # magic \x93NUMPY, version (1,0), little-endian u2 header length
     if len(value) < 10 or bytes(value[:8]) != b'\x93NUMPY\x01\x00':
         return None
@@ -166,6 +211,24 @@ def _fast_npy_decode(value):
     shape_src = m.group(2)
     shape = tuple(int(p) for p in shape_src.replace(b' ', b'').split(b',') if p) \
         if shape_src else ()
+    return dtype, shape, header_end
+
+
+def _fast_npy_decode(value):
+    """Decode an ``np.save`` payload without ast-based header parsing;
+    returns None when the payload is not in the standard v1 form.
+    ``value`` may be ``bytes`` or any buffer-protocol object (the columnar
+    reader passes zero-copy uint8 ndarray views).
+
+    Returns a WRITABLE array (one memcpy), matching what ``np.load`` gives
+    consumers on the fallback path — an in-place transform must not work for
+    one serialization form and crash for another."""
+    if isinstance(value, np.ndarray):
+        value = memoryview(value)
+    parsed = _parse_fast_npy_header(value)
+    if parsed is None:
+        return None
+    dtype, shape, header_end = parsed
     flat = np.frombuffer(value, dtype=dtype, offset=header_end)
     return flat.reshape(shape).copy()
 
@@ -199,6 +262,50 @@ class NdarrayCodec(DataframeColumnCodec):
                 return fast
             return np.load(io.BytesIO(cell))
         return decode_cell
+
+    def make_column_decoder(self, unischema_field):
+        """Vectorized whole-chunk decode: when every cell is the same
+        machine-generated ``np.save`` v1 payload (identical header bytes,
+        identical stride — the invariant a fixed-shape column written by
+        :meth:`encode` satisfies by construction), the entire chunk decodes
+        with ONE header compare and ONE contiguous copy instead of N
+        Python calls. Anything else punts to the per-cell loop."""
+        shape = unischema_field.shape
+        if shape is None or any(s is None for s in shape):
+            return None   # wildcard fields keep the per-cell object contract
+
+        def decode_chunk(chunk):
+            if chunk.null_count:
+                return None
+            n = len(chunk)
+            offsets, data = split_binary_chunk(chunk)
+            stride = int(offsets[1]) - int(offsets[0])
+            if stride <= 10 or not bool(
+                    np.all(np.diff(offsets) == stride)):
+                return None
+            block = data[int(offsets[0]):int(offsets[-1])]
+            parsed = _parse_fast_npy_header(memoryview(block[:stride]))
+            if parsed is None:
+                return None
+            dtype, cell_shape, header_end = parsed
+            expected = int(np.prod(cell_shape, dtype=np.int64)) * dtype.itemsize
+            if stride - header_end != expected:
+                return None
+            grid = block.reshape(n, stride)
+            # one vectorized compare proves every cell shares the first
+            # cell's exact header (dtype AND shape), so one copy decodes all
+            if not bool((grid[:, :header_end] == grid[0, :header_end]).all()):
+                return None
+            payload = np.ascontiguousarray(grid[:, header_end:])
+            if not payload.flags.writeable:
+                # a 1-row chunk's payload slice is already contiguous, so
+                # ascontiguousarray returns the read-only arrow-buffer view
+                # itself; the per-cell path promises WRITABLE arrays
+                payload = payload.copy()
+            if not expected:      # zero-size cells: nothing to reinterpret
+                return np.empty((n,) + cell_shape, dtype=dtype)
+            return payload.view(dtype).reshape((n,) + cell_shape)
+        return decode_chunk
 
     def arrow_type(self, unischema_field):
         return pa.binary()
@@ -333,6 +440,42 @@ class CompressedImageCodec(DataframeColumnCodec):
                 return cvt_color(img, bgr2rgb)
             return img
         return decode_cell
+
+    def make_column_decoder(self, unischema_field):
+        """Batched buffer-splitting decode: the chunk's cells are sliced
+        from the arrow data buffer in one offsets pass, the only per-cell
+        work is the actual image decompression (a C-level ``map`` over
+        ``cv2.imdecode`` — no Python loop machinery between cells), and
+        the decoded frames assemble straight into one dense array.
+        Mixed-geometry or corrupt chunks punt to the per-cell loop, which
+        owns the exact error/quarantine semantics."""
+        import cv2
+        imdecode, cvt_color = cv2.imdecode, cv2.cvtColor
+        bgr2rgb, flag = cv2.COLOR_BGR2RGB, cv2.IMREAD_UNCHANGED
+
+        def decode_chunk(chunk):
+            if chunk.null_count:
+                return None
+            offsets, data = split_binary_chunk(chunk)
+            cells = list(map(data.__getitem__,
+                             map(slice, offsets[:-1].tolist(),
+                                 offsets[1:].tolist())))
+            decoded = list(map(imdecode, cells, repeat(flag)))
+            # a failed imdecode must surface as the per-cell path's
+            # field-named ValueError at the exact row: punt, don't guess
+            if any(img is None for img in decoded):
+                return None
+            first = decoded[0]
+            if first.ndim == 3 and first.shape[2] == 3:
+                # cvtColor raising on a mixed gray/color chunk propagates
+                # to the caller, which retries per cell (same punt)
+                decoded = list(map(cvt_color, decoded, repeat(bgr2rgb)))
+                first = decoded[0]
+            out = np.empty((len(decoded),) + first.shape, first.dtype)
+            for i, img in enumerate(decoded):
+                out[i] = img      # shape mismatch raises -> per-cell retry
+            return out
+        return decode_chunk
 
     def validate_decode_hint(self, unischema_field, min_shape=None,
                              scale=None, allow_upscale=False):
@@ -478,6 +621,27 @@ class ScalarCodec(DataframeColumnCodec):
         if dtype.kind in ('U', 'S', 'O'):
             return value
         return dtype.type(value)
+
+    def make_column_decoder(self, unischema_field):
+        """Pass-through fields (string/bytes/object dtypes, whose
+        :meth:`decode` returns the stored value unchanged) decode a binary
+        chunk with one ``to_pylist`` call instead of a per-cell
+        view->bytes->decode loop. Numeric-from-binary fields keep the
+        per-cell path (its contract is one numpy scalar per cell)."""
+        try:
+            kind = np.dtype(unischema_field.numpy_dtype).kind
+        except TypeError:   # a non-dtype-able declaration: per-cell decides
+            return None
+        if kind not in ('U', 'S', 'O'):
+            return None
+
+        def decode_chunk(chunk):
+            if chunk.null_count:
+                return None
+            out = np.empty(len(chunk), dtype=object)
+            out[:] = chunk.to_pylist()
+            return out
+        return decode_chunk
 
     def arrow_type(self, unischema_field):
         dtype = self._storage_dtype(unischema_field)

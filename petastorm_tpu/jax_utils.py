@@ -63,6 +63,41 @@ def _is_device_compatible(arr):
     return getattr(arr, 'dtype', np.dtype(object)).kind not in _DEVICE_INCOMPATIBLE_KINDS
 
 
+def _contiguous_rows_view(vals):
+    """Zero-copy batch assembly: when ``vals`` are consecutive row views of
+    one dense ``(n, *shape)`` decoded column (the unshuffled row-stream
+    case — worker columns split into row dicts, consumed in order), the
+    batch is a contiguous range of that column and one slice replaces the
+    per-row ``np.stack`` memcpy. Returns ``None`` whenever that cannot be
+    proven — shuffled rows, process-pool reconstructed rows, scalar or
+    object cells — and the caller keeps the copying path. The slice shares
+    the column's memory (and its writability): treat collated batches as
+    read-only, as ``docs/decode.md`` documents."""
+    first = vals[0]
+    base = first.base
+    if base is None or not isinstance(base, np.ndarray) or first.ndim == 0:
+        return None
+    if (base.ndim != first.ndim + 1 or base.shape[1:] != first.shape
+            or base.dtype != first.dtype
+            or base.dtype.kind in _DEVICE_INCOMPATIBLE_KINDS):
+        return None
+    row_bytes = base.strides[0]
+    if row_bytes <= 0 or first.strides != base.strides[1:]:
+        return None
+    base_ptr = base.__array_interface__['data'][0]
+    ptr = first.__array_interface__['data'][0]
+    start, rem = divmod(ptr - base_ptr, row_bytes)
+    if rem or start < 0 or start + len(vals) > base.shape[0]:
+        return None
+    for v in vals[1:]:
+        ptr += row_bytes
+        if (v.base is not base or v.shape != first.shape
+                or v.dtype != first.dtype or v.strides != first.strides
+                or v.__array_interface__['data'][0] != ptr):
+            return None
+    return base[start:start + len(vals)]
+
+
 def validate_pad_spec(pad_spec):
     """Normalize/validate a ragged-padding spec at loader construction.
 
@@ -477,6 +512,16 @@ class JaxDataLoader(JaxLoaderBase):
         offsets, base, fields_at = self._ngram.timestep_layout(
             self.reader.schema.fields)
 
+        def take_rows(col, pos):
+            # windows over a gap-free row range index consecutive rows:
+            # slice the decoded column zero-copy instead of a fancy-index
+            # gather (contiguous-slice batch assembly, docs/decode.md)
+            if (len(pos) and int(pos[-1]) - int(pos[0]) == len(pos) - 1
+                    and bool(np.all(np.diff(pos) == 1))):
+                lo = int(pos[0])
+                return col[lo:lo + len(pos)]
+            return col[pos]
+
         def collate_chunks():
             for chunk in self.reader.iter_ngram_chunks():
                 flat = {}
@@ -485,7 +530,8 @@ class JaxDataLoader(JaxLoaderBase):
                     for name in fields_at[off]:
                         col = chunk.columns.get(name)
                         if col is not None:
-                            flat[(off, name)] = _sanitize_value(col[pos])
+                            flat[(off, name)] = _sanitize_value(
+                                take_rows(col, pos))
                 yield flat
 
         def unflatten(batch):
@@ -562,6 +608,13 @@ class JaxDataLoader(JaxLoaderBase):
                                      count=len(rows))
                 continue
             vals = [np.asarray(r[k]) for r in rows]
+            contiguous = _contiguous_rows_view(vals)
+            if contiguous is not None:
+                # the batch IS a contiguous range of one decoded column:
+                # emit the zero-copy slice instead of re-collating rows
+                # (docs/decode.md "contiguous-slice batch assembly")
+                out[k] = contiguous
+                continue
             shapes = {v.shape for v in vals}
             kinds = {v.dtype.kind for v in vals}
             if len(shapes) == 1 and not (kinds & set(_DEVICE_INCOMPATIBLE_KINDS)):
@@ -747,7 +800,8 @@ def infeed_diagnosis(snapshot: dict, heartbeats=None,
     """
     from petastorm_tpu.health import (DEFAULT_STALL_AFTER_S,
                                       bottleneck_signals, classify_pipeline)
-    from petastorm_tpu.workers.stats import (readahead_hit_rate,
+    from petastorm_tpu.workers.stats import (batched_decode_fraction,
+                                             readahead_hit_rate,
                                              recommend_io_readahead)
     signals = bottleneck_signals(snapshot)
     io_s, decode_s = signals['io_s'], signals['decode_s']
@@ -760,6 +814,9 @@ def infeed_diagnosis(snapshot: dict, heartbeats=None,
         'readahead_hit_rate': readahead_hit_rate(snapshot),
         'recommended_io_readahead': recommend_io_readahead(snapshot),
         'rows_quarantined': snapshot.get('rows_quarantined', 0),
+        'rows_decoded_batched': snapshot.get('rows_decoded_batched', 0),
+        'rows_decoded_percell': snapshot.get('rows_decoded_percell', 0),
+        'batched_decode_fraction': batched_decode_fraction(snapshot),
         'hint': signals['hint'],
     }
     if heartbeats is not None:

@@ -40,6 +40,61 @@ class in_set(PredicateBase):
     def do_include(self, values):
         return values[self._predicate_field] in self._inclusion_values
 
+    def column_mask(self, columns) -> Optional[np.ndarray]:
+        """Vectorized membership over a decoded numpy column (the columnar
+        readers' ``predicate_row_mask`` hook): one ``np.isin`` instead of a
+        per-row dict build + set probe. Returns ``None`` — caller falls
+        back to per-row ``do_include`` — whenever numpy elementwise
+        equality could disagree with Python's ``in``: object columns,
+        mixed-kind value sets, NaN members (set membership is
+        identity-based), and any int/float pairing whose float64
+        promotion would round exact integers (int64 x uint64, members or
+        64-bit columns beyond 2**53)."""
+        column = columns.get(self._predicate_field)
+        dtype = getattr(column, 'dtype', None)
+        if dtype is None or dtype.kind not in 'biufUS':
+            return None
+        if getattr(column, 'ndim', 0) != 1:
+            # a dense (n, *shape) array column would yield an elementwise
+            # N-D mask ("any element matches" rows, duplicated indices at
+            # the nonzero() callers) where the per-row path raises loudly
+            # on the unhashable cell — keep that loud failure
+            return None
+        try:
+            values = np.asarray(list(self._inclusion_values))
+        except (ValueError, TypeError, OverflowError):
+            return None
+        ck, vk = dtype.kind, values.dtype.kind
+        if ck in 'US':
+            if vk != ck:
+                return None
+        elif ck in 'bui' and vk in 'bui':
+            # int64 x uint64 promotes to float64 inside np.isin — 2**63
+            # neighbors collide after rounding where Python's exact int
+            # compare would not
+            if np.result_type(dtype, values.dtype).kind not in 'bui':
+                return None
+        elif ck == 'f' and vk == 'f':
+            if np.isnan(values).any():
+                return None  # nan in {nan} is True (identity); ==nan isn't
+        elif ck == 'f' and vk in 'bui':
+            # integer members compare exactly against a float column only
+            # when float64 represents each one exactly — proven by the
+            # round trip (a magnitude test would itself round 2**53 + 1)
+            promoted = values.astype(np.float64)
+            if not bool(np.array_equal(promoted.astype(values.dtype),
+                                       values)):
+                return None
+            values = promoted
+        elif ck in 'ui' and vk == 'f':
+            # np.isin promotes the COLUMN to float64: exact only for
+            # <=32-bit integer columns (int64 values beyond 2**53 round)
+            if dtype.itemsize > 4:
+                return None
+        else:
+            return None
+        return np.isin(column, values)
+
 
 class in_intersection(PredicateBase):
     """True if a list-valued field intersects the given set."""

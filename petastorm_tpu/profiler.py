@@ -63,6 +63,15 @@ PROFILER_ENV_VAR = 'PETASTORM_TPU_PROFILER'
 #: Where calibration artifacts live when ``cache_dir`` is not passed.
 CALIBRATION_DIR_ENV_VAR = 'PETASTORM_TPU_CALIBRATION_DIR'
 
+#: Probe-methodology version stamped into every calibration artifact and
+#: required on load: a cached ceiling is only comparable to measurements
+#: taken through the SAME decode path. Bumped to 2 when the decode probe
+#: moved onto the row-group-vectorized path (docs/decode.md) — a per-cell
+#: ceiling served against batched measurements would misreport
+#: roofline_fraction by up to the batched speedup. Artifacts from older
+#: probe versions (or with no version at all) read as a cache miss.
+PROBE_SCHEMA_VERSION = 2
+
 #: Pipeline stages a ceiling is calibrated for, in pipeline order.
 CEILING_STAGES = ('io', 'decode', 'serialize', 'device_stage')
 
@@ -145,8 +154,10 @@ def calibration_path(digest: str, cache_dir: Optional[str] = None) -> str:
 def load_calibration(digest: str,
                      cache_dir: Optional[str] = None) -> Optional[dict]:
     """The cached calibration for ``digest`` on this host, or ``None`` on a
-    miss, an unreadable artifact, or a digest mismatch (defense in depth —
-    the digest is in the filename AND the payload)."""
+    miss, an unreadable artifact, a digest mismatch (defense in depth —
+    the digest is in the filename AND the payload), or a probe-version
+    mismatch (ceilings measured through an older decode path must not
+    judge this one's measurements)."""
     path = calibration_path(digest, cache_dir)
     try:
         with open(path) as f:
@@ -154,6 +165,8 @@ def load_calibration(digest: str,
     except (OSError, ValueError):
         return None
     if cal.get('dataset_digest') != digest:
+        return None
+    if cal.get('probe_version') != PROBE_SCHEMA_VERSION:
         return None
     return cal
 
@@ -255,12 +268,18 @@ def _probe_storage(filesystem, sampled) -> dict:
 
 def _probe_decode(filesystem, sampled, schema) -> dict:
     """Per-codec decode throughput through the REAL decode path
-    (``columnar_worker._column_to_numpy``, honoring each field's codec) over
-    the sampled row groups. One untimed pass warms codec imports and the
-    column buffers; the timed pass is the single-core decode ceiling."""
+    (``columnar_worker._column_to_numpy``, honoring each field's codec and
+    the same batched/per-cell routing the workers use — the ceiling must
+    measure the path the pipeline runs) over the sampled row groups. One
+    untimed pass warms codec imports and the column buffers; the timed
+    pass is the single-core decode ceiling. Each per-codec entry records
+    the cells decoded by the vectorized path (``batched_rows``) so the
+    calibration artifact shows which ceilings are batched-path numbers."""
     import pyarrow.parquet as pq
 
+    from petastorm_tpu.codecs import batched_decode_enabled
     from petastorm_tpu.readers.columnar_worker import _column_to_numpy
+    batched = batched_decode_enabled()
     names = [name for name, field in schema.fields.items()]
     per_codec: Dict[str, dict] = {}
     rows = 0
@@ -275,15 +294,20 @@ def _probe_decode(filesystem, sampled, schema) -> dict:
         present = [n for n in names if n in table.column_names]
         # warm pass: codec imports, lazy cv2 init, chunk materialization
         for name in present:
-            _column_to_numpy(table.column(name), schema.fields[name], None)
+            _column_to_numpy(table.column(name), schema.fields[name], None,
+                             batched=batched)
         n = table.num_rows
         rows += n
         for name in present:
             field = schema.fields[name]
             elapsed, out = None, None
+            path_counts = {'batched': 0, 'percell': 0}
             for _ in range(PROBE_REPS):
+                path_counts = {'batched': 0, 'percell': 0}
                 start = time.perf_counter()
-                out = _column_to_numpy(table.column(name), field, None)
+                out = _column_to_numpy(table.column(name), field, None,
+                                       batched=batched,
+                                       path_counts=path_counts)
                 took = time.perf_counter() - start
                 elapsed = took if elapsed is None else min(elapsed, took)
             total_s += elapsed
@@ -293,9 +317,11 @@ def _probe_decode(filesystem, sampled, schema) -> dict:
             if image_format:
                 label = '{}({})'.format(label, str(image_format).lstrip('.'))
             entry = per_codec.setdefault(label, {'rows': 0, 'seconds': 0.0,
-                                                 'decoded_bytes': 0})
+                                                 'decoded_bytes': 0,
+                                                 'batched_rows': 0})
             entry['rows'] += n
             entry['seconds'] += elapsed
+            entry['batched_rows'] += path_counts['batched']
             nbytes = getattr(out, 'nbytes', 0)
             entry['decoded_bytes'] += int(nbytes)
             decoded_bytes += int(nbytes)
@@ -431,6 +457,7 @@ def calibrate(filesystem, dataset_path, pieces, schema,
     }
     calibration = {
         'kind': 'petastorm_tpu_roofline_calibration',
+        'probe_version': PROBE_SCHEMA_VERSION,
         'host': socket.gethostname(),
         'cpu_count': os.cpu_count() or 1,
         'dataset_path': str(dataset_path),

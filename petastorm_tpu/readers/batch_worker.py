@@ -55,9 +55,9 @@ class BatchResultsReader:
             # python lists, :66-77)
             from petastorm_tpu.readers.columnar_worker import _list_column_to_numpy
             return _list_column_to_numpy(column, field)
-        if pa.types.is_string(column.type) or pa.types.is_large_string(column.type) \
-                or pa.types.is_binary(column.type) or pa.types.is_large_binary(column.type):
-            return np.asarray(column.to_pylist(), dtype=object)
+        # string/binary columns convert in the same C++ call as numerics
+        # now (an object array of str/bytes with None at nulls) — the old
+        # to_pylist -> np.asarray round trip built every cell twice
         return column.to_numpy(zero_copy_only=False)
 
 

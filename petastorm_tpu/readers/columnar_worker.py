@@ -20,6 +20,7 @@ from typing import Dict, Optional
 import numpy as np
 import pyarrow as pa
 
+from petastorm_tpu.codecs import batched_decode_enabled, split_binary_chunk
 from petastorm_tpu.lineage import NEVER_QUARANTINE, unwrap_envelope
 from petastorm_tpu.readers.piece_worker import ParquetPieceWorker
 from petastorm_tpu.utils import cast_partition_value
@@ -60,13 +61,7 @@ def _binary_cell_views(column: pa.ChunkedArray) -> list:
         n = len(chunk)
         if not n:
             continue
-        validity, offsets_buf, data_buf = chunk.buffers()
-        off_dtype = np.dtype(
-            np.int64 if pa.types.is_large_binary(chunk.type) else np.int32)
-        offsets = np.frombuffer(offsets_buf, dtype=off_dtype, count=n + 1,
-                                offset=chunk.offset * off_dtype.itemsize)
-        data = (np.frombuffer(data_buf, dtype=np.uint8)
-                if data_buf is not None else np.empty(0, np.uint8))
+        offsets, data = split_binary_chunk(chunk)
         if chunk.null_count:
             valid = chunk.is_valid().to_numpy(zero_copy_only=False)
             cells.extend(
@@ -78,15 +73,71 @@ def _binary_cell_views(column: pa.ChunkedArray) -> list:
     return cells
 
 
+def _decode_column_batched(column: pa.ChunkedArray, field,
+                           n: int) -> Optional[np.ndarray]:
+    """One-call-per-chunk vectorized decode via the codec's
+    ``make_column_decoder``, or ``None`` to punt to the per-cell loop.
+
+    Per the batched contract (``docs/decode.md``) this path only runs for
+    fixed-shape fields on null-free columns; any chunk the codec cannot
+    vectorize (or that raises — corrupt cells included) punts the WHOLE
+    column, so error/quarantine semantics stay exactly the per-cell
+    loop's."""
+    make = getattr(field.codec, 'make_column_decoder', None)
+    if make is None:
+        return None
+    decode_chunk = make(field)
+    if decode_chunk is None:
+        return None
+    parts = []
+    for chunk in column.chunks:
+        if not len(chunk):
+            continue
+        try:
+            part = decode_chunk(chunk)
+        except NEVER_QUARANTINE:
+            raise   # infrastructure failure, not a bad sample: stay loud
+        except Exception:  # noqa: BLE001 - per-cell retry owns the error
+            return None
+        if part is None:
+            return None
+        parts.append(part)
+    if not parts:
+        return None
+    if len(parts) > 1:
+        first = parts[0]
+        if any(p.dtype != first.dtype or p.shape[1:] != first.shape[1:]
+               for p in parts[1:]):
+            # cross-chunk geometry drift: the per-cell dense loop would
+            # fail its assignment — let it own that failure
+            return None
+        out = np.concatenate(parts)
+    else:
+        out = parts[0]
+    return out if len(out) == n else None
+
+
 def _decode_binary_column(column: pa.ChunkedArray, field,
                           decode_override=None,
-                          on_cell_error=None) -> np.ndarray:
+                          on_cell_error=None, batched=True,
+                          path_counts=None) -> np.ndarray:
     """Decode a codec-encoded binary column into (n, *shape) (fixed shapes)
     or an object array (wildcard shapes, null cells, non-ndarray payloads).
 
-    Cells reach the decoder as zero-copy buffer views and the per-cell
-    callable comes from ``codec.make_cell_decoder`` (per-column setup hoisted
-    out of the loop) — the two halves of keeping this loop pure decode.
+    The row-group-vectorized path runs first (``batched``, default on):
+    fixed-shape, null-free, non-overridden columns decode through the
+    codec's ``make_column_decoder`` — one numpy/pyarrow call per column
+    chunk instead of N Python calls. Columns the codec cannot vectorize
+    (and any chunk that raises) fall back to the per-cell loop below,
+    which owns the exact error/quarantine semantics; ``path_counts``
+    (``{'batched': int, 'percell': int}``) records which path decoded how
+    many cells, feeding the ``rows_decoded_batched``/``rows_decoded_percell``
+    counters.
+
+    On the per-cell path, cells reach the decoder as zero-copy buffer views
+    and the callable comes from ``codec.make_cell_decoder`` (per-column
+    setup hoisted out of the loop) — the two halves of keeping this loop
+    pure decode.
 
     ``on_cell_error`` (bad-sample quarantine, see
     :mod:`petastorm_tpu.lineage`): instead of a corrupt cell killing the
@@ -100,6 +151,15 @@ def _decode_binary_column(column: pa.ChunkedArray, field,
         if fixed:
             return np.empty((0,) + tuple(field.shape), dtype=field.numpy_dtype)
         return np.empty(0, dtype=object)
+    if (batched and decode_override is None and fixed
+            and column.null_count == 0 and field.codec is not None):
+        out = _decode_column_batched(column, field, n)
+        if out is not None:
+            if path_counts is not None:
+                path_counts['batched'] += n
+            return out
+    if path_counts is not None:
+        path_counts['percell'] += n
     decode = decode_override or field.codec.make_cell_decoder(field)
     cells = _binary_cell_views(column)
     if on_cell_error is not None:
@@ -182,19 +242,31 @@ def _list_column_to_numpy(column: pa.ChunkedArray, field) -> np.ndarray:
 
 
 def _column_to_numpy(column: pa.ChunkedArray, field,
-                     decode_override=None, on_cell_error=None) -> np.ndarray:
+                     decode_override=None, on_cell_error=None,
+                     batched=None, path_counts=None) -> np.ndarray:
     """Decoded numpy column for any unischema field. ``on_cell_error``
     enables tolerant codec decode (see :func:`_decode_binary_column`);
     vectorized scalar/list conversions cannot isolate cells and fail
-    whole-column under every policy."""
+    whole-column under every policy. ``batched``/``path_counts`` gate and
+    observe the row-group-vectorized codec path; the default (``None``)
+    consults the ``PETASTORM_TPU_BATCHED_DECODE`` switch per call, so
+    every caller honors the kill switch — workers pass their
+    construction-time read explicitly to keep the env lookup off the
+    per-column hot path."""
+    if batched is None:
+        batched = batched_decode_enabled()
     if field.codec is not None and (
             pa.types.is_binary(column.type) or pa.types.is_large_binary(column.type)):
         return _decode_binary_column(column, field, decode_override,
-                                     on_cell_error=on_cell_error)
+                                     on_cell_error=on_cell_error,
+                                     batched=batched,
+                                     path_counts=path_counts)
     if pa.types.is_list(column.type) or pa.types.is_large_list(column.type):
         return _list_column_to_numpy(column, field)
     if pa.types.is_string(column.type) or pa.types.is_large_string(column.type):
-        return np.asarray(column.to_pylist(), dtype=object)
+        # one C++ conversion instead of a to_pylist -> np.asarray round
+        # trip; both produce an object array of str with None at nulls
+        return column.to_numpy(zero_copy_only=False)
     arr = column.to_numpy(zero_copy_only=False)
     if field.numpy_dtype is not None and not field.shape:
         try:
@@ -309,8 +381,19 @@ def transform_fingerprint(spec) -> str:
 
 
 def predicate_row_mask(predicate, fields, cols, n: int) -> np.ndarray:
-    """Boolean include-mask from ``predicate.do_include`` over row dicts built
-    from decoded columns."""
+    """Boolean include-mask from ``predicate`` over decoded columns.
+
+    Predicates exposing a ``column_mask`` hook (e.g. the common
+    single-field :class:`~petastorm_tpu.predicates.in_set` membership)
+    evaluate in one vectorized numpy call; the hook returns ``None`` for
+    column dtypes where numpy equality could diverge from Python's (object
+    columns, NaN members), and generic predicates without the hook keep
+    the per-row dict path."""
+    column_mask = getattr(predicate, 'column_mask', None)
+    if column_mask is not None:
+        mask = column_mask(cols)
+        if mask is not None:
+            return np.asarray(mask, dtype=bool)
     return np.fromiter(
         (bool(predicate.do_include({f: cols[f][i] for f in fields}))
          for i in range(n)), dtype=bool, count=n)

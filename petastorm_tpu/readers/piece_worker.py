@@ -123,12 +123,16 @@ class ParquetPieceWorker(WorkerBase):
         self._local_cache = args['local_cache']
         self._transform_spec = args['transform_spec']
         self._transformed_schema = args['transformed_schema']
-        from petastorm_tpu.codecs import build_decode_overrides
+        from petastorm_tpu.codecs import (batched_decode_enabled,
+                                          build_decode_overrides)
         # built here (not in the factory) so only plain dicts cross the
         # process-pool pickle boundary
         self._decode_hints = args.get('decode_hints')
         self._decode_overrides = build_decode_overrides(
             self._full_schema, self._decode_hints)
+        # row-group-vectorized codec decode (docs/decode.md); the env kill
+        # switch is read once per worker, never per cell
+        self._batched_decode = batched_decode_enabled()
         # pre_buffer coalesces a row group's column chunks into few large
         # ranged reads — the right shape for object stores (GCS/S3/HDFS),
         # pure overhead for local mmap-fast files
@@ -332,6 +336,7 @@ class ParquetPieceWorker(WorkerBase):
         self.beat('decode')   # entry beat: a wedged codec shows as `decode`
         start = time.perf_counter()
         out = {}
+        path_counts = {'batched': 0, 'percell': 0}
         for name in names:
             if name not in table.column_names:
                 continue
@@ -344,7 +349,9 @@ class ParquetPieceWorker(WorkerBase):
             errors_before = len(error_sink.errors) if error_sink else 0
             out[name] = _column_to_numpy(column, field,
                                          self._decode_overrides.get(name),
-                                         on_cell_error=on_cell_error)
+                                         on_cell_error=on_cell_error,
+                                         batched=self._batched_decode,
+                                         path_counts=path_counts)
             if (error_sink is not None
                     and len(error_sink.errors) > errors_before
                     and field.shape is not None
@@ -353,6 +360,10 @@ class ParquetPieceWorker(WorkerBase):
                 # the fast path would have produced a dense (n, *shape)
                 # array; after the bad rows are dropped, restore that
                 error_sink.dense_fields.add(name)
+        if path_counts['batched']:
+            self.record_count('rows_decoded_batched', path_counts['batched'])
+        if path_counts['percell']:
+            self.record_count('rows_decoded_percell', path_counts['percell'])
         self.record_span('decode_columns', 'decode', start,
                          time.perf_counter() - start)
         return out

@@ -48,6 +48,11 @@ COUNTERS = (
     'readahead_misses',  # row-group reads that went inline (not prefetched)
     'rows_quarantined',  # rows dropped under on_decode_error='skip'/'quarantine'
     'items_quarantined',  # quarantine/skip events (items or row batches)
+    'rows_decoded_batched',  # codec column cells decoded by the vectorized
+                             # row-group path (docs/decode.md)
+    'rows_decoded_percell',  # codec column cells that fell back to the
+                             # per-cell loop (wildcard shapes, nulls,
+                             # decode hints, punted/corrupt chunks)
     'shared_hits',       # row groups served from the host-wide shared cache
     'shared_misses',     # shared-cache lookups that fell through to io+decode
     'shared_evictions',  # shared-cache segments evicted/spilled (this reader)
@@ -213,6 +218,20 @@ def readahead_hit_rate(snapshot: dict) -> float:
     """Fraction of row-group reads served from the prefetch queue."""
     hits = snapshot.get('readahead_hits', 0)
     return hits / max(1, hits + snapshot.get('readahead_misses', 0))
+
+
+def batched_decode_fraction(snapshot: dict):
+    """Fraction of codec column cells decoded by the vectorized row-group
+    path (``None`` when no codec cells were decoded at all — scalar-only
+    views must not read as "0% batched"). A decode-bound pipeline showing
+    a low fraction here is paying per-cell Python the batched path exists
+    to remove — ``docs/troubleshooting.md`` has the triage."""
+    batched = snapshot.get('rows_decoded_batched', 0)
+    percell = snapshot.get('rows_decoded_percell', 0)
+    total = batched + percell
+    if not total:
+        return None
+    return round(batched / total, 4)
 
 
 def recommend_io_readahead(snapshot: dict, max_depth: int = 8) -> int:

@@ -1,0 +1,575 @@
+"""Row-group-vectorized (batched) codec decode tests: bit-identity against
+the per-cell loop for every registered codec across nulls / empty chunks /
+multi-chunk columns / corrupt cells, quarantine row-offset and provenance
+parity, the ``rows_decoded_batched``/``rows_decoded_percell`` observability
+split, the ``PETASTORM_TPU_BATCHED_DECODE`` kill switch, contiguous-slice
+batch assembly (``jax_utils._contiguous_rows_view``), and the vectorized
+``predicate_row_mask`` fast path."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.codecs import (BATCHED_DECODE_ENV_VAR,
+                                  CompressedImageCodec,
+                                  CompressedNdarrayCodec, NdarrayCodec,
+                                  ScalarCodec, batched_decode_enabled)
+from petastorm_tpu.jax_utils import (JaxDataLoader, _contiguous_rows_view,
+                                     infeed_diagnosis)
+from petastorm_tpu.predicates import in_lambda, in_set
+from petastorm_tpu.reader import make_columnar_reader, make_reader
+from petastorm_tpu.readers.columnar_worker import (_column_to_numpy,
+                                                   predicate_row_mask)
+from petastorm_tpu.unischema import UnischemaField
+from petastorm_tpu.workers.stats import batched_decode_fraction
+
+RNG = np.random.default_rng(7)
+
+
+def _encode_cells(codec, field, values):
+    return [None if v is None else codec.encode(field, v) for v in values]
+
+
+def _chunked(cells, chunk_sizes=None, arrow_type=pa.binary()):
+    """A (large_)binary ChunkedArray from encoded cells, optionally split
+    into the given chunk sizes (0 = an empty chunk in the middle)."""
+    if chunk_sizes is None:
+        return pa.chunked_array([pa.array(cells, type=arrow_type)])
+    chunks, at = [], 0
+    for size in chunk_sizes:
+        chunks.append(pa.array(cells[at:at + size], type=arrow_type))
+        at += size
+    assert at == len(cells), 'chunk_sizes must cover every cell'
+    return pa.chunked_array(chunks, type=arrow_type)
+
+
+def _assert_bit_identical(column, field, expect_batched=None):
+    """Decode ``column`` both ways; the outputs must match exactly (dtype,
+    shape, every element — object arrays compared cell-wise). Returns the
+    batched-path counts of the ``batched=True`` run."""
+    counts = {'batched': 0, 'percell': 0}
+    out_b = _column_to_numpy(column, field, None, batched=True,
+                             path_counts=counts)
+    out_p = _column_to_numpy(column, field, None, batched=False)
+    assert out_b.dtype == out_p.dtype
+    assert out_b.shape == out_p.shape
+    if out_b.dtype == object:
+        for cell_b, cell_p in zip(out_b, out_p):
+            if cell_b is None or cell_p is None:
+                assert cell_b is None and cell_p is None
+            elif isinstance(cell_b, np.ndarray):
+                assert cell_b.dtype == cell_p.dtype
+                assert bool(np.array_equal(cell_b, cell_p))
+            else:
+                assert cell_b == cell_p
+    else:
+        assert bool(np.array_equal(out_b, out_p))
+    if expect_batched is not None:
+        assert counts['batched'] == expect_batched
+    return counts
+
+
+class TestColumnDecoderBitIdentity:
+    """The docs/decode.md contract: for every registered codec the batched
+    path's output is bit-identical to the per-cell loop's, across nulls,
+    empty chunks, and multi-chunk columns — or it punts entirely."""
+
+    def test_ndarray_fixed_shape_single_chunk(self):
+        field = UnischemaField('m', np.float32, (4, 3), NdarrayCodec(), False)
+        values = [RNG.standard_normal((4, 3)).astype(np.float32)
+                  for _ in range(16)]
+        column = _chunked(_encode_cells(field.codec, field, values))
+        counts = _assert_bit_identical(column, field, expect_batched=16)
+        assert counts['percell'] == 0
+
+    def test_ndarray_multi_chunk_with_empty_chunk(self):
+        field = UnischemaField('m', np.int16, (5,), NdarrayCodec(), False)
+        values = [RNG.integers(-99, 99, (5,)).astype(np.int16)
+                  for _ in range(12)]
+        column = _chunked(_encode_cells(field.codec, field, values),
+                          chunk_sizes=(5, 0, 4, 3))
+        _assert_bit_identical(column, field, expect_batched=12)
+
+    def test_ndarray_large_binary(self):
+        field = UnischemaField('m', np.float64, (2, 2), NdarrayCodec(), False)
+        values = [RNG.standard_normal((2, 2)) for _ in range(8)]
+        column = _chunked(_encode_cells(field.codec, field, values),
+                          arrow_type=pa.large_binary())
+        _assert_bit_identical(column, field, expect_batched=8)
+
+    def test_ndarray_nulls_fall_back_per_cell(self):
+        field = UnischemaField('m', np.int32, (3,), NdarrayCodec(), True)
+        values = [RNG.integers(0, 9, (3,)).astype(np.int32), None,
+                  RNG.integers(0, 9, (3,)).astype(np.int32), None]
+        column = _chunked(_encode_cells(field.codec, field, values))
+        counts = _assert_bit_identical(column, field, expect_batched=0)
+        assert counts['percell'] == len(values)
+
+    def test_ndarray_wildcard_shape_falls_back_per_cell(self):
+        field = UnischemaField('m', np.int32, (None,), NdarrayCodec(), False)
+        values = [RNG.integers(0, 9, (k + 1,)).astype(np.int32)
+                  for k in range(6)]
+        column = _chunked(_encode_cells(field.codec, field, values))
+        _assert_bit_identical(column, field, expect_batched=0)
+
+    def test_ndarray_empty_column(self):
+        field = UnischemaField('m', np.float32, (4,), NdarrayCodec(), False)
+        column = _chunked([])
+        _assert_bit_identical(column, field, expect_batched=0)
+
+    def test_ndarray_batched_output_is_writable(self):
+        # the per-cell path promises WRITABLE arrays (in-place transforms);
+        # a 1-row chunk's payload slice is contiguous already, so without
+        # the explicit copy it would stay a read-only arrow-buffer view
+        field = UnischemaField('m', np.float32, (4,), NdarrayCodec(), False)
+        for n in (1, 6):
+            values = [RNG.standard_normal((4,)).astype(np.float32)
+                      for _ in range(n)]
+            column = _chunked(_encode_cells(field.codec, field, values))
+            out = _column_to_numpy(column, field, None, batched=True)
+            assert out.flags.writeable
+            out[0, 0] = 42.0   # must not raise
+
+    def test_ndarray_zero_size_cells(self):
+        field = UnischemaField('m', np.float32, (0,), NdarrayCodec(), False)
+        values = [np.empty((0,), dtype=np.float32) for _ in range(5)]
+        column = _chunked(_encode_cells(field.codec, field, values))
+        _assert_bit_identical(column, field, expect_batched=5)
+
+    @pytest.mark.parametrize('shape', [(9, 7, 3), (9, 7)])
+    def test_png_image_rgb_and_grayscale(self, shape):
+        field = UnischemaField('im', np.uint8, shape,
+                               CompressedImageCodec('png'), False)
+        values = [RNG.integers(0, 255, shape).astype(np.uint8)
+                  for _ in range(10)]
+        column = _chunked(_encode_cells(field.codec, field, values),
+                          chunk_sizes=(6, 4))
+        counts = _assert_bit_identical(column, field, expect_batched=10)
+        assert counts['percell'] == 0
+
+    def test_jpeg_image(self):
+        field = UnischemaField('im', np.uint8, (16, 16, 3),
+                               CompressedImageCodec('jpeg', quality=90),
+                               False)
+        values = [RNG.integers(0, 255, (16, 16, 3)).astype(np.uint8)
+                  for _ in range(6)]
+        column = _chunked(_encode_cells(field.codec, field, values))
+        _assert_bit_identical(column, field, expect_batched=6)
+
+    def test_compressed_ndarray_has_no_vectorized_path(self):
+        field = UnischemaField('m', np.uint16, (2, 3),
+                               CompressedNdarrayCodec(), False)
+        values = [RNG.integers(0, 999, (2, 3)).astype(np.uint16)
+                  for _ in range(7)]
+        column = _chunked(_encode_cells(field.codec, field, values))
+        counts = _assert_bit_identical(column, field, expect_batched=0)
+        assert counts['percell'] == 7
+
+    def test_scalar_bytes_passthrough(self):
+        field = UnischemaField('b', np.bytes_, (), ScalarCodec(), False)
+        values = [b'alpha', b'', b'\x00\xff binary']
+        column = _chunked(_encode_cells(field.codec, field, values))
+        counts = _assert_bit_identical(column, field, expect_batched=3)
+        assert counts['percell'] == 0
+
+    def test_scalar_numeric_keeps_per_cell_contract(self):
+        # numeric-from-binary ScalarCodec fields decline the vectorized
+        # path (decode returns one numpy scalar per cell)
+        codec = ScalarCodec(numpy_dtype=np.dtype('S8'))
+        field = UnischemaField('s', np.int32, (), codec, False)
+        assert codec.make_column_decoder(field) is None
+
+    def test_mixed_header_chunk_punts(self):
+        # hand-built cells sharing one length but not one header: the
+        # vectorized header compare must reject the chunk, and the
+        # per-cell loop owns whatever happens next — identically under
+        # both settings (here: both raise on the dense-shape mismatch)
+        import io
+        field = UnischemaField('m', np.float32, (4,), NdarrayCodec(), False)
+        good = io.BytesIO()
+        np.save(good, np.ones(4, dtype=np.float32))
+        bad = io.BytesIO()
+        np.save(bad, np.ones(2, dtype=np.float64))
+        cells = [good.getvalue(), bad.getvalue()]
+        assert len(cells[0]) == len(cells[1])
+        column = _chunked(cells)
+        with pytest.raises(ValueError):
+            _column_to_numpy(column, field, None, batched=True)
+        with pytest.raises(ValueError):
+            _column_to_numpy(column, field, None, batched=False)
+
+
+class TestQuarantineParity:
+    """Corrupt cells must surface the SAME failing row offsets whether the
+    batched path ran first or not: batched decode punts the column and the
+    per-cell retry isolates the rows."""
+
+    def _poisoned_column(self):
+        field = UnischemaField('m', np.float32, (4,), NdarrayCodec(), False)
+        values = [RNG.standard_normal((4,)).astype(np.float32)
+                  for _ in range(10)]
+        cells = _encode_cells(field.codec, field, values)
+        cells[3] = b'garbage-not-npy'
+        cells[7] = b'also garbage!!!'
+        return _chunked(cells), field
+
+    @pytest.mark.parametrize('batched', [True, False])
+    def test_same_offsets_both_paths(self, batched):
+        column, field = self._poisoned_column()
+        failures = []
+        out = _column_to_numpy(
+            column, field, None,
+            on_cell_error=lambda i, e: failures.append(i), batched=batched)
+        assert failures == [3, 7]
+        assert out.dtype == object
+        assert out[3] is None and out[7] is None
+        assert out[0].dtype == np.float32
+
+    def test_batched_outputs_match_per_cell_under_quarantine(self):
+        column, field = self._poisoned_column()
+        outs = []
+        for batched in (True, False):
+            sink = []
+            outs.append(_column_to_numpy(
+                column, field, None,
+                on_cell_error=lambda i, e: sink.append(i), batched=batched))
+        for cell_b, cell_p in zip(*outs):
+            if cell_b is None:
+                assert cell_p is None
+            else:
+                assert bool(np.array_equal(cell_b, cell_p))
+
+
+@pytest.fixture()
+def corrupt_store(tmp_path):
+    """TestSchema store with one garbage 'matrix' cell (1-row row groups
+    preserved so the petastorm metadata stays truthful)."""
+    import os
+    from petastorm_tpu.test_util.dataset_gen import create_test_dataset
+    url = 'file://' + str(tmp_path / 'corrupt')
+    create_test_dataset(url, range(20), num_files=2)
+    path = str(tmp_path / 'corrupt')
+    files = sorted(os.path.join(path, f) for f in os.listdir(path)
+                   if f.endswith('.parquet'))
+    table = pq.read_table(files[0])
+    cells = table.column('matrix').to_pylist()
+    cells[2] = b'garbage-not-an-encoded-ndarray'
+    idx = table.column_names.index('matrix')
+    table = table.set_column(idx, 'matrix', pa.array(
+        cells, type=table.schema.field('matrix').type))
+    pq.write_table(table, files[0], row_group_size=1)
+    return url
+
+
+class TestEndToEndParity:
+    """Full reader passes with the kill switch on vs off: identical rows,
+    identical quarantine records, identical provenance, audit green."""
+
+    def _columnar_pass(self, url, monkeypatch, batched, **kwargs):
+        monkeypatch.setenv(BATCHED_DECODE_ENV_VAR, '1' if batched else '0')
+        batches = []
+        with make_columnar_reader(url, reader_pool_type='thread',
+                                  workers_count=2, num_epochs=1,
+                                  shuffle_row_groups=False,
+                                  **kwargs) as reader:
+            for batch in reader:
+                batches.append(batch)
+            snapshot = reader.diagnostics
+            report = reader.audit().assert_complete()
+        return batches, snapshot, report
+
+    def test_columnar_reader_identical_and_audited(self, synthetic_dataset,
+                                                   monkeypatch):
+        got = {}
+        for batched in (True, False):
+            batches, snapshot, report = self._columnar_pass(
+                synthetic_dataset.url, monkeypatch, batched)
+            rows = {}
+            for batch in batches:
+                for i, row_id in enumerate(batch.id):
+                    rows[int(row_id)] = {
+                        'matrix': batch.matrix[i],
+                        'image_png': batch.image_png[i],
+                        'partition_key': batch.partition_key[i],
+                    }
+            got[batched] = rows
+            if batched:
+                assert snapshot['rows_decoded_batched'] > 0
+            else:
+                assert snapshot['rows_decoded_batched'] == 0
+                assert snapshot['rows_decoded_percell'] > 0
+            assert report['epochs'][0]['row_exact']
+        assert set(got[True]) == set(got[False]) == set(
+            range(len(synthetic_dataset.data)))
+        for row_id, row in got[True].items():
+            other = got[False][row_id]
+            for key, value in row.items():
+                if isinstance(value, np.ndarray):
+                    assert value.dtype == other[key].dtype
+                    assert bool(np.array_equal(value, other[key]))
+                else:
+                    assert value == other[key]
+
+    def test_quarantine_offsets_and_provenance_identical(self, corrupt_store,
+                                                         monkeypatch):
+        per_mode = {}
+        for batched in (True, False):
+            monkeypatch.setenv(BATCHED_DECODE_ENV_VAR,
+                               '1' if batched else '0')
+            with make_reader(corrupt_store, reader_pool_type='thread',
+                             workers_count=1, num_epochs=1,
+                             shuffle_row_groups=False,
+                             on_decode_error='quarantine') as reader:
+                ids = sorted(int(r.id) for r in reader)
+                records = reader.lineage.quarantines()
+                rows_quarantined = reader.diagnostics['rows_quarantined']
+                reader.audit().assert_complete()
+            assert rows_quarantined == 1
+            assert len(records) == 1
+            record = records[0]
+            per_mode[batched] = (ids, record['row_offsets'], record['field'],
+                                 record['stage'], record['path'],
+                                 record['row_group'])
+        assert per_mode[True] == per_mode[False]
+
+    def test_loader_batches_identical(self, synthetic_dataset, monkeypatch):
+        """Contiguous-slice batch assembly must not change loader output:
+        same batches under batched and per-cell decode, shuffle off."""
+        per_mode = {}
+        for batched in (True, False):
+            monkeypatch.setenv(BATCHED_DECODE_ENV_VAR,
+                               '1' if batched else '0')
+            collected = []
+            with make_reader(synthetic_dataset.url,
+                             reader_pool_type='thread', workers_count=1,
+                             num_epochs=1, shuffle_row_groups=False) as r:
+                with JaxDataLoader(r, batch_size=8,
+                                   shuffling_queue_capacity=0) as loader:
+                    for batch in loader:
+                        collected.append((np.array(batch['id']),
+                                          np.array(batch['matrix'])))
+            per_mode[batched] = collected
+        assert len(per_mode[True]) == len(per_mode[False])
+        for (ids_b, mat_b), (ids_p, mat_p) in zip(per_mode[True],
+                                                  per_mode[False]):
+            assert bool(np.array_equal(ids_b, ids_p))
+            assert mat_b.dtype == mat_p.dtype
+            assert bool(np.array_equal(mat_b, mat_p))
+
+
+class TestObservability:
+    def test_kill_switch_forms(self, monkeypatch):
+        for off in ('0', 'false', 'off', ' OFF '):
+            monkeypatch.setenv(BATCHED_DECODE_ENV_VAR, off)
+            assert not batched_decode_enabled()
+        for on in ('1', 'true', ''):
+            monkeypatch.setenv(BATCHED_DECODE_ENV_VAR, on)
+            assert batched_decode_enabled()
+        monkeypatch.delenv(BATCHED_DECODE_ENV_VAR, raising=False)
+        assert batched_decode_enabled()
+
+    def test_default_batched_arg_honors_kill_switch(self, monkeypatch):
+        # callers that don't thread `batched` (indexed reader, ad-hoc
+        # probes) must still honor the env switch: the default consults it
+        field = UnischemaField('m', np.float32, (4,), NdarrayCodec(), False)
+        values = [RNG.standard_normal((4,)).astype(np.float32)
+                  for _ in range(4)]
+        column = _chunked(_encode_cells(field.codec, field, values))
+        for off, expect_batched in (('0', 0), ('1', 4)):
+            monkeypatch.setenv(BATCHED_DECODE_ENV_VAR, off)
+            counts = {'batched': 0, 'percell': 0}
+            _column_to_numpy(column, field, None, path_counts=counts)
+            assert counts['batched'] == expect_batched
+
+    def test_calibration_probe_version_gates_cache(self, tmp_path,
+                                                   monkeypatch):
+        # a pre-batched-decode calibration artifact (no probe_version, or
+        # an older one) must read as a cache miss, never as a ceiling
+        import json
+        from petastorm_tpu import profiler
+        monkeypatch.setenv(profiler.CALIBRATION_DIR_ENV_VAR, str(tmp_path))
+        cal = {'kind': 'petastorm_tpu_roofline_calibration',
+               'probe_version': profiler.PROBE_SCHEMA_VERSION,
+               'dataset_digest': 'abc123'}
+        profiler.save_calibration(cal)
+        assert profiler.load_calibration('abc123') is not None
+        for stale in ({}, {'probe_version': profiler.PROBE_SCHEMA_VERSION
+                           - 1}):
+            stale_cal = dict(cal, dataset_digest='stale01', **stale)
+            stale_cal.pop('probe_version', None)
+            stale_cal.update(stale)
+            path = profiler.calibration_path('stale01')
+            with open(path, 'w') as f:      # petalint: disable=atomic-publish
+                json.dump(stale_cal, f)
+            assert profiler.load_calibration('stale01') is None
+
+    def test_batched_decode_fraction(self):
+        assert batched_decode_fraction({}) is None
+        assert batched_decode_fraction({'rows_decoded_batched': 0,
+                                        'rows_decoded_percell': 0}) is None
+        assert batched_decode_fraction({'rows_decoded_batched': 3,
+                                        'rows_decoded_percell': 1}) == 0.75
+
+    def test_infeed_diagnosis_carries_split(self, synthetic_dataset):
+        with make_columnar_reader(synthetic_dataset.url,
+                                  reader_pool_type='thread',
+                                  workers_count=1, num_epochs=1,
+                                  shuffle_row_groups=False) as reader:
+            for _ in reader:
+                pass
+            diag = infeed_diagnosis(reader.diagnostics)
+        assert diag['rows_decoded_batched'] > 0
+        assert diag['batched_decode_fraction'] is not None
+        assert 0.0 < diag['batched_decode_fraction'] <= 1.0
+
+    def test_process_pool_ships_counters(self, synthetic_dataset):
+        with make_columnar_reader(synthetic_dataset.url,
+                                  reader_pool_type='process',
+                                  workers_count=2, num_epochs=1,
+                                  shuffle_row_groups=False) as reader:
+            rows = sum(len(b.id) for b in reader)
+            snapshot = reader.diagnostics
+        assert rows == len(synthetic_dataset.data)
+        assert snapshot['rows_decoded_batched'] > 0
+
+
+class TestContiguousRowsView:
+    def _base(self, n=10, shape=(4, 3)):
+        return RNG.standard_normal((n,) + shape).astype(np.float32)
+
+    def test_contiguous_range_is_zero_copy(self):
+        base = self._base()
+        vals = [base[i] for i in range(2, 7)]
+        out = _contiguous_rows_view(vals)
+        assert out is not None
+        assert bool(np.shares_memory(out, base))
+        assert bool(np.array_equal(out, np.stack(vals)))
+
+    def test_full_range(self):
+        base = self._base(4)
+        out = _contiguous_rows_view([base[i] for i in range(4)])
+        assert out is not None and out.shape == base.shape
+        assert bool(np.array_equal(out, base))
+
+    def test_shuffled_rows_decline(self):
+        base = self._base()
+        assert _contiguous_rows_view([base[3], base[1], base[2]]) is None
+
+    def test_gap_declines(self):
+        base = self._base()
+        assert _contiguous_rows_view([base[0], base[2]]) is None
+
+    def test_reversed_declines(self):
+        base = self._base()
+        assert _contiguous_rows_view([base[5], base[4]]) is None
+
+    def test_mixed_bases_decline(self):
+        a, b = self._base(), self._base()
+        assert _contiguous_rows_view([a[0], b[1]]) is None
+
+    def test_fresh_arrays_decline(self):
+        vals = [RNG.standard_normal(3).astype(np.float32) for _ in range(3)]
+        assert _contiguous_rows_view(vals) is None
+
+    def test_scalar_rows_decline(self):
+        base = np.arange(10.0)
+        assert _contiguous_rows_view([base[2], base[3]]) is None
+
+    def test_object_dtype_declines(self):
+        base = np.empty((4, 2), dtype=object)
+        base[:] = 'x'
+        assert _contiguous_rows_view([base[0], base[1]]) is None
+
+    def test_strided_base_rows(self):
+        # rows of a [::2]-strided view: consecutive in the VIEW but their
+        # pointer step disagrees with base.strides[0] of that view's base
+        base = self._base(10)
+        view = base[::2]
+        vals = [view[1], view[2], view[3]]
+        out = _contiguous_rows_view(vals)
+        # either a correct view of the strided parent or a clean decline —
+        # never a wrong answer
+        if out is not None:
+            assert bool(np.array_equal(out, np.stack(vals)))
+
+
+class TestPredicateMask:
+    def _mask_both_ways(self, predicate, cols, n):
+        fields = predicate.get_fields()
+        vectorized = predicate_row_mask(predicate, fields, cols, n)
+        per_row = np.fromiter(
+            (bool(predicate.do_include({f: cols[f][i] for f in fields}))
+             for i in range(n)), dtype=bool, count=n)
+        assert bool(np.array_equal(vectorized, per_row))
+        return vectorized
+
+    def test_in_set_int_column(self):
+        cols = {'id': np.arange(20, dtype=np.int64)}
+        mask = self._mask_both_ways(in_set([3, 5, 19], 'id'), cols, 20)
+        assert mask.sum() == 3
+
+    def test_in_set_unicode_column(self):
+        cols = {'name': np.asarray(['a', 'b', 'c', 'd'])}
+        self._mask_both_ways(in_set(['b', 'd', 'zz'], 'name'), cols, 4)
+
+    def test_in_set_object_column_falls_back(self):
+        col = np.empty(4, dtype=object)
+        col[:] = ['a', 'b', 'c', 'd']
+        predicate = in_set(['b'], 'name')
+        assert predicate.column_mask({'name': col}) is None
+        self._mask_both_ways(predicate, {'name': col}, 4)
+
+    def test_in_set_nan_falls_back(self):
+        predicate = in_set([1.0, float('nan')], 'x')
+        cols = {'x': np.asarray([1.0, 2.0, np.nan])}
+        assert predicate.column_mask(cols) is None
+
+    def test_in_set_mixed_kinds_fall_back(self):
+        predicate = in_set([1, 'a'], 'x')
+        assert predicate.column_mask({'x': np.arange(3)}) is None
+
+    def test_in_set_int_float_promotions_fall_back(self):
+        # every pairing whose float64 promotion rounds exact integers must
+        # decline — np.isin would include rows Python's `in` excludes
+        wide = {'x': np.asarray([2 ** 63 + 1024], dtype=np.uint64)}
+        assert in_set([np.int64(-1)], 'x').column_mask(wide) is None
+        big_int_members = in_set([2 ** 53 + 1], 'x')
+        assert big_int_members.column_mask(
+            {'x': np.asarray([float(2 ** 53)])}) is None
+        int64_col = {'x': np.asarray([2 ** 53 + 1], dtype=np.int64)}
+        assert in_set([float(2 ** 53)], 'x').column_mask(int64_col) is None
+
+    def test_in_set_array_column_declines(self):
+        # a dense (n, shape) column must not become an elementwise 2-D
+        # mask — the per-row path raises on the unhashable ndarray cell,
+        # and that loud failure must survive vectorization
+        predicate = in_set([1, 5], 'vec')
+        dense = {'vec': np.asarray([[1, 2, 3], [4, 5, 6]], dtype=np.int64)}
+        assert predicate.column_mask(dense) is None
+        with pytest.raises(TypeError):
+            predicate_row_mask(predicate, ['vec'], dense, 2)
+
+    def test_in_set_exact_int_float_mixes_vectorize(self):
+        # int32 column x float members and float column x small-int
+        # members promote exactly: vectorized, and equal to the row path
+        cols = {'x': np.asarray([1, 2, 3], dtype=np.int32)}
+        self._mask_both_ways(in_set([1.0, 2.5], 'x'), cols, 3)
+        fcols = {'x': np.asarray([1.0, 2.0, 2.5])}
+        self._mask_both_ways(in_set([1, 2], 'x'), fcols, 3)
+
+    def test_generic_predicate_keeps_row_path(self):
+        predicate = in_lambda(['id'], lambda row: row['id'] % 2 == 0)
+        cols = {'id': np.arange(10, dtype=np.int64)}
+        mask = self._mask_both_ways(predicate, cols, 10)
+        assert mask.sum() == 5
+
+    def test_columnar_reader_predicate_rows(self, synthetic_dataset):
+        wanted = {0, 7, 42, 99}
+        with make_columnar_reader(synthetic_dataset.url,
+                                  reader_pool_type='thread',
+                                  workers_count=1, num_epochs=1,
+                                  shuffle_row_groups=False,
+                                  predicate=in_set(wanted, 'id')) as reader:
+            got = sorted(int(i) for b in reader for i in b.id)
+        assert got == sorted(wanted)
