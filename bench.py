@@ -276,6 +276,12 @@ def main(argv=None):
         run_lineage_overhead_bench
     lineage_overhead = run_lineage_overhead_bench(quick=True)
 
+    # -- latency: default-on histogram/SLO plane overhead (on vs off) -------
+    # Same smoke-vs-headline split: the <5% figure lives in BENCH_r14.json.
+    from petastorm_tpu.benchmark.latency_overhead import \
+        run_latency_overhead_bench
+    latency_overhead = run_latency_overhead_bench(quick=True)
+
     # -- shared cache: K readers x one dataset, decoded once ----------------
     # Quick mode asserts the decode-once invariant and warm-vs-roofline; the
     # >=2x aggregate headline lives in BENCH_r11.json from the full run.
@@ -490,6 +496,7 @@ def main(argv=None):
         'readahead': readahead,
         'trace_overhead': trace_overhead,
         'lineage_overhead': lineage_overhead,
+        'latency_overhead': latency_overhead,
         'shared_cache': shared_cache,
         'roofline_bench': roofline_bench,
         'decode_batch': decode_batch,

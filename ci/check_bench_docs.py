@@ -44,7 +44,7 @@ ANNOTATION = re.compile(
 DEFAULT_DOCS = ('docs/benchmarks.md', 'docs/transport.md',
                 'docs/readahead.md', 'docs/tracing.md', 'docs/health.md',
                 'docs/lineage.md', 'docs/cache.md', 'docs/profiling.md',
-                'docs/decode.md')
+                'docs/decode.md', 'docs/latency.md')
 MIN_ANNOTATIONS = 30
 
 #: Artifacts that MUST be quoted by at least one annotation across the
@@ -54,10 +54,11 @@ MIN_ANNOTATIONS = 30
 #: BENCH_r10, the lineage-overhead record; round-11 adds BENCH_r11, the
 #: shared-cache decode-once record; round-12 adds BENCH_r12, the roofline
 #: calibration + attribution record; round-13 adds BENCH_r13, the
-#: batched-decode A/B + roofline record).
+#: batched-decode A/B + roofline record; round-14 adds BENCH_r14, the
+#: latency-plane overhead record).
 REQUIRED_ARTIFACTS = ('BENCH_r06.json', 'BENCH_r07.json', 'BENCH_r08.json',
                       'BENCH_r09.json', 'BENCH_r10.json', 'BENCH_r11.json',
-                      'BENCH_r12.json', 'BENCH_r13.json')
+                      'BENCH_r12.json', 'BENCH_r13.json', 'BENCH_r14.json')
 
 def check_artifacts_intact(root: str = ROOT):
     """Reject any committed ``BENCH_*.json`` that carries a ``parsed`` key

@@ -216,16 +216,17 @@ def _decode_batch_entries(artifact, round_no, blob):
 
 
 def _overhead_entries(artifact, round_no, blob):
-    """Entries from the alternating-pass overhead benches (r08/r09/r10):
-    the stable signal is the BASELINE items/s (the overhead pct is a claim
-    about a delta, not a rate)."""
+    """Entries from the alternating-pass overhead benches (r08/r09/r10, and
+    r14's latency-overhead record which additionally carries its measured
+    ``spread_pct``): the stable signal is the BASELINE items/s (the overhead
+    pct is a claim about a delta, not a rate)."""
     baseline = blob.get('baseline_items_per_s')
     if not isinstance(baseline, (int, float)):
         return []
     config = {'platform': 'host', 'quick': bool(blob.get('quick')),
               'rows': blob.get('rows'), 'workers': blob.get('workers')}
     return [_entry(artifact, round_no, 'overhead_baseline_items_per_s',
-                   config, baseline)]
+                   config, baseline, spread_pct=blob.get('spread_pct'))]
 
 
 def _shared_cache_entries(artifact, round_no, blob):

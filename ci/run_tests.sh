@@ -59,6 +59,12 @@ python -m pytest tests/test_lineage.py -q
 echo '== lineage-overhead quick bench (provenance+audit ledgers on vs off) =='
 python -m petastorm_tpu.benchmark.lineage_overhead --quick
 
+echo '== latency quick checks (histograms, rolling windows, SLO monitor, /slo; lockdep on) =='
+PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_latency.py -q
+
+echo '== latency-overhead quick bench (streaming histograms + SLO monitor on vs off) =='
+python -m petastorm_tpu.benchmark.latency_overhead --quick
+
 echo '== shared-cache quick checks (tiered segments, pins, concurrent attach; lockdep on) =='
 PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_sharedcache.py -q
 

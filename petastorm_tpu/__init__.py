@@ -16,6 +16,7 @@ __all__ = ['make_reader', 'make_batch_reader', 'make_columnar_reader',
            'TransformSpec', 'NoDataAvailableError',
            'make_jax_loader', 'make_dataset_converter', 'materialize_dataset',
            'CoverageAuditor', 'Provenance', 'SharedRowGroupCache',
+           'LatencyHistogram', 'SLOMonitor',
            '__version__']
 
 
@@ -48,4 +49,7 @@ def __getattr__(name):
     if name == 'SharedRowGroupCache':
         from petastorm_tpu.sharedcache import SharedRowGroupCache
         return SharedRowGroupCache
+    if name in ('LatencyHistogram', 'SLOMonitor'):
+        from petastorm_tpu import latency
+        return getattr(latency, name)
     raise AttributeError('module {!r} has no attribute {!r}'.format(__name__, name))

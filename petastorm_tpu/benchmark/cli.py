@@ -95,6 +95,15 @@ def build_parser() -> argparse.ArgumentParser:
                              'and shared; shared bounds the disk tier, with '
                              'the shared-memory tier capped at min(this, '
                              '1 GiB))')
+    parser.add_argument('--slo-p99-ms', type=float, default=None,
+                        help='arm the SLO monitor with a p99 end-to-end '
+                             'batch-latency target (milliseconds over the '
+                             'rolling window); the verdict — per-target '
+                             'checks + error-budget burn — prints after the '
+                             'run (see docs/latency.md)')
+    parser.add_argument('--slo-min-samples-per-s', type=float, default=None,
+                        help='add a minimum samples/s target to the SLO '
+                             'monitor (window rate from ReaderStats)')
     parser.add_argument('--on-decode-error', default='raise',
                         choices=['raise', 'skip', 'quarantine'],
                         help="bad-sample policy: 'raise' propagates decode/"
@@ -117,6 +126,11 @@ def main(argv=None) -> int:
                                           and args.cache_size_limit):
         raise SystemExit('--cache-type {} needs --cache-location and '
                          '--cache-size-limit'.format(args.cache_type))
+    slo = {}
+    if args.slo_p99_ms is not None:
+        slo['p99_e2e_ms'] = args.slo_p99_ms
+    if args.slo_min_samples_per_s is not None:
+        slo['min_samples_per_s'] = args.slo_min_samples_per_s
     results = [reader_throughput(
         args.dataset_url, field_regex=args.field_regex,
         warmup_cycles=args.warmup_cycles, measure_cycles=args.measure_cycles,
@@ -128,7 +142,7 @@ def main(argv=None) -> int:
         metrics_interval=args.metrics_interval,
         metrics_out=args.metrics_out, debug_port=args.debug_port,
         stall_timeout=args.stall_timeout, audit=args.audit,
-        profile=args.profile,
+        profile=args.profile, slo=slo or None,
         on_decode_error=args.on_decode_error, cache_type=args.cache_type,
         cache_location=args.cache_location,
         cache_size_limit=args.cache_size_limit)
@@ -149,8 +163,11 @@ def main(argv=None) -> int:
     if args.diagnostics and result.diagnostics is not None:
         import json
         print('Pipeline telemetry (median run): {}'.format(
-            json.dumps({k: (round(v, 4) if isinstance(v, float) else v)
-                        for k, v in sorted(result.diagnostics.items())})))
+            json.dumps({k: (round(v, 6) if isinstance(v, float) else v)
+                        for k, v in sorted(result.diagnostics.items())
+                        # raw histogram states belong to /metrics scrapes;
+                        # the derived *_p50_s/*_p99_s keys print here
+                        if not k.startswith('_')})))
         if result.diagnosis is not None:
             # the same classification the watchdog / GET /healthz makes
             # (infeed_diagnosis over the snapshot + live heartbeats)
@@ -163,6 +180,10 @@ def main(argv=None) -> int:
         print('Roofline (median run): {}'.format(explain(result.profile)))
         print('Roofline profile: {}'.format(
             json.dumps(result.profile, sort_keys=True, default=str)))
+    if slo and result.slo is not None:
+        import json
+        print('SLO verdict (median run): {}'.format(
+            json.dumps(result.slo, sort_keys=True, default=str)))
     if args.audit and result.audit is not None:
         import json
         print('Coverage audit (median run): {}'.format(

@@ -43,6 +43,11 @@ class ThroughputResult:
     #: recommendations — what the CLI's ``--profile`` prints. ``None``
     #: when not requested (see ``docs/profiling.md``).
     profile: Optional[dict] = None
+    #: The SLO verdict (``reader.slo.evaluate()``) of the measured window
+    #: when ``slo=dict(...)`` targets were passed: per-target checks +
+    #: error-budget burn — what the CLI's ``--slo-p99-ms`` prints. ``None``
+    #: when no targets were set (see ``docs/latency.md``).
+    slo: Optional[dict] = None
 
 
 def _consume(iterator, count: int, batched: bool) -> int:
@@ -78,6 +83,7 @@ def reader_throughput(dataset_url: str,
                       stall_timeout: float = 0,
                       audit: bool = False,
                       profile: bool = False,
+                      slo: Optional[dict] = None,
                       on_decode_error: str = 'raise',
                       cache_type: str = 'null',
                       cache_location: Optional[str] = None,
@@ -106,7 +112,7 @@ def reader_throughput(dataset_url: str,
                   debug_port=debug_port, stall_timeout=stall_timeout,
                   on_decode_error=on_decode_error, cache_type=cache_type,
                   cache_location=cache_location,
-                  cache_size_limit=cache_size_limit)
+                  cache_size_limit=cache_size_limit, slo=slo)
     if field_regex is not None:
         kwargs['schema_fields'] = field_regex
 
@@ -142,11 +148,16 @@ def reader_throughput(dataset_url: str,
         from petastorm_tpu.jax_utils import infeed_diagnosis
         health = getattr(reader, 'health', None)
         watchdog = getattr(reader, 'watchdog', None)
+        slo_verdict = None
+        monitor = getattr(reader, 'slo', None)
+        if monitor is not None:
+            slo_verdict = monitor.evaluate()
         diagnosis = infeed_diagnosis(
             diagnostics,
             heartbeats=health.heartbeats() if health is not None else None,
             stall_after_s=watchdog.stall_after_s
-            if watchdog is not None else None)
+            if watchdog is not None else None,
+            slo=slo_verdict)
         if trace_path is not None and reader.tracer is not None:
             reader.tracer.export_chrome_trace(trace_path)
         audit_report = None
@@ -174,4 +185,5 @@ def reader_throughput(dataset_url: str,
                             diagnostics=diagnostics,
                             diagnosis=diagnosis,
                             audit=audit_report,
-                            profile=profile_report)
+                            profile=profile_report,
+                            slo=slo_verdict)

@@ -210,14 +210,35 @@ def bottleneck_signals(snapshot: dict) -> dict:
     and :func:`classify_pipeline` (the watchdog), so the CLI's ``-d`` output
     and ``/healthz`` can never disagree.
 
-    Returns ``{'bottleneck', 'hint', 'io_s', 'decode_s'}``; thresholds and
-    wording match ``docs/troubleshooting.md``."""
+    Returns ``{'bottleneck', 'hint', 'io_s', 'decode_s'}`` plus the
+    queue-wait tail keys; thresholds and wording match
+    ``docs/troubleshooting.md``.
+
+    The consumer-wait **distribution** (not its mean) separates two regimes
+    the sums cannot: steady backpressure (p50 ≈ p99 — the reader is simply
+    slower than the consumer) vs **tail stalls** (p50 near zero but p99
+    large: most batches are ready instantly, yet every Nth delivery stalls
+    the device — the contention signature of a worker-pool + bounded-queue
+    pipeline). A tail-stall verdict rides out as ``tail_stall: True`` with
+    its own hint; see ``docs/latency.md``."""
     from petastorm_tpu.workers.stats import effective_io_s
     io_s = effective_io_s(snapshot)
     decode_s = snapshot.get('worker_decode_s', 0.0)
     publish_wait_s = snapshot.get('worker_publish_wait_s', 0.0)
+    qw_p50 = snapshot.get('queue_wait_p50_s', 0.0)
+    qw_p99 = snapshot.get('queue_wait_p99_s', 0.0)
+    # tail stall: the p99 consumer wait dwarfs the median AND is large
+    # enough to matter (>= 50ms) — mean-based signals read this as healthy
+    tail_stall = bool(qw_p99 >= 0.05 and qw_p99 > 10.0 * max(qw_p50, 1e-4))
     busy = io_s + decode_s
-    if publish_wait_s > busy:
+    if tail_stall:
+        bottleneck = 'tail-stall'
+        hint = ('queue-wait p99 ({:.3f}s) dwarfs p50 ({:.4f}s): most '
+                'batches arrive instantly but every Nth delivery stalls '
+                'the consumer — look at the /slo burn, the flight-record '
+                'p99 trend and per-stage histograms, not the means '
+                '(docs/latency.md)'.format(qw_p99, qw_p50))
+    elif publish_wait_s > busy:
         bottleneck = 'consumer'
         hint = ('workers outrun the consumer (publish_wait > io+decode): '
                 'the training step / consumer loop is the ceiling')
@@ -234,7 +255,8 @@ def bottleneck_signals(snapshot: dict) -> dict:
         hint = ('io and decode are comparable: io_readahead overlaps them '
                 'for up to 2x; workers_count scales both')
     return {'bottleneck': bottleneck, 'hint': hint, 'io_s': io_s,
-            'decode_s': decode_s}
+            'decode_s': decode_s, 'queue_wait_p50_s': qw_p50,
+            'queue_wait_p99_s': qw_p99, 'tail_stall': tail_stall}
 
 
 def classify_pipeline(heartbeats: Dict[str, dict],
@@ -324,7 +346,9 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
                         queues: Optional[dict] = None,
                         tracer=None, span_tail: int = 500,
                         lineage: Optional[dict] = None,
-                        roofline: Optional[dict] = None) -> dict:
+                        roofline: Optional[dict] = None,
+                        latency: Optional[dict] = None,
+                        slo: Optional[dict] = None) -> dict:
     """Assemble the flight-recorder artifact: everything needed to diagnose
     a stall *after* the process is gone. JSON-able by construction.
     ``lineage`` (a tracker's ``flight_summary()``) adds the coverage audit
@@ -333,7 +357,11 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
     ``roofline`` (a profiler ``roofline_summary()``) records how far below
     its calibrated ceiling the pipeline was running when it died — a stall
     that follows a long degradation reads differently from one out of the
-    blue (see ``docs/profiling.md``)."""
+    blue (see ``docs/profiling.md``). ``latency`` (a
+    ``PipelineLatency.flight_summary()``) embeds per-stage percentiles plus
+    the recent per-interval p99 trend — whether the episode was a cliff or
+    a creep; ``slo`` (an ``SLOMonitor.evaluate()`` verdict) records the
+    burn state at the moment of death (see ``docs/latency.md``)."""
     record = {
         'kind': 'petastorm_tpu_flight_record',
         # deliberate wall clock: a human-facing artifact timestamp, never
@@ -353,6 +381,10 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
         record['lineage'] = lineage
     if roofline is not None:
         record['roofline'] = roofline
+    if latency is not None:
+        record['latency'] = latency
+    if slo is not None:
+        record['slo'] = slo
     return record
 
 
@@ -381,13 +413,18 @@ class PipelineWatchdog:
                  snapshot_fn: Optional[Callable[[], dict]] = None,
                  stall_after_s: float = DEFAULT_STALL_AFTER_S,
                  interval_s: Optional[float] = None,
-                 on_stall: Optional[Callable[[dict], None]] = None):
+                 on_stall: Optional[Callable[[dict], None]] = None,
+                 slo_monitor=None):
         if stall_after_s <= 0:
             raise ValueError('stall_after_s must be positive, got '
                              '{!r}'.format(stall_after_s))
         self._heartbeats_fn = heartbeats_fn
         self._snapshot_fn = snapshot_fn
         self._stall_after_s = stall_after_s
+        #: Optional :class:`petastorm_tpu.latency.SLOMonitor`: the watchdog
+        #: thread drives its periodic evaluations (burn accounting needs a
+        #: steady cadence, not just on-demand ``/slo`` probes).
+        self._slo_monitor = slo_monitor
         # default tick: a quarter of the threshold, clamped so tiny test
         # thresholds do not busy-spin and huge ones still tick regularly
         self._interval = (interval_s if interval_s is not None
@@ -443,6 +480,11 @@ class PipelineWatchdog:
             except Exception:
                 logger.exception('watchdog evaluation failed')
                 continue
+            if self._slo_monitor is not None:
+                try:
+                    self._slo_monitor.evaluate()
+                except Exception:
+                    logger.exception('SLO evaluation failed')
             if verdict['state'] == STALLED:
                 if not self._stall_fired:
                     self._stall_fired = True
@@ -476,7 +518,14 @@ class DebugServer:
 
     - ``GET /healthz`` — the watchdog verdict as JSON; status 200, or 503
       when the pipeline is classified ``stalled`` (point a k8s liveness
-      probe at it).
+      probe at it). When an SLO monitor with the ``fail_healthz`` target is
+      wired and its error budget is spent (``hard_breach``), ``/healthz``
+      also flips to 503 with the SLO verdict embedded — the recycle signal
+      for an infeed that is up but violating its latency contract.
+    - ``GET /slo`` — the SLO monitor's verdict
+      (:meth:`petastorm_tpu.latency.SLOMonitor.evaluate`): per-target
+      checks, breach list, error-budget burn rate. 404 when the reader was
+      built without ``slo=`` targets.
     - ``GET /metrics`` — the stats snapshot in Prometheus text-exposition
       format (the metrics emitter's formatter).
     - ``GET /diagnostics`` — ``{stats, heartbeats, verdict}`` (plus the
@@ -502,12 +551,14 @@ class DebugServer:
                  heartbeats_fn: Optional[Callable[[], Dict[str, dict]]] = None,
                  port: int = 0, prefix: str = 'petastorm_tpu',
                  coverage_fn: Optional[Callable[[], dict]] = None,
-                 profile_fn: Optional[Callable[[], dict]] = None):
+                 profile_fn: Optional[Callable[[], dict]] = None,
+                 slo_fn: Optional[Callable[[], dict]] = None):
         self._evaluate_fn = evaluate_fn
         self._snapshot_fn = snapshot_fn or (lambda: {})
         self._heartbeats_fn = heartbeats_fn or (lambda: {})
         self._coverage_fn = coverage_fn
         self._profile_fn = profile_fn
+        self._slo_fn = slo_fn
         self._requested_port = port
         self._prefix = prefix
         self._server = None
@@ -539,8 +590,27 @@ class DebugServer:
                     if route == '/healthz':
                         verdict = outer._evaluate_fn()
                         status = 503 if verdict.get('state') == STALLED else 200
+                        if outer._slo_fn is not None:
+                            # a spent error budget is a liveness failure only
+                            # when the operator opted in (fail_healthz): an
+                            # SLO is a contract, 503 is a recycle signal
+                            slo_verdict = outer._slo_fn()
+                            verdict = dict(verdict, slo=slo_verdict)
+                            if (slo_verdict.get('fail_healthz')
+                                    and slo_verdict.get('hard_breach')):
+                                status = 503
                         self._reply(status, 'application/json',
                                     json.dumps(verdict, default=str))
+                    elif route == '/slo':
+                        if outer._slo_fn is None:
+                            self._reply(404, 'text/plain',
+                                        'no SLO targets configured for this '
+                                        'reader (pass slo=dict(...) to the '
+                                        'factory)\n')
+                        else:
+                            self._reply(200, 'application/json',
+                                        json.dumps(outer._slo_fn(),
+                                                   default=str))
                     elif route == '/metrics':
                         from petastorm_tpu.tracing import prometheus_text
                         self._reply(200, 'text/plain; version=0.0.4',
@@ -552,6 +622,8 @@ class DebugServer:
                                 'heartbeats': outer._heartbeats_fn()}
                         if outer._coverage_fn is not None:
                             blob['coverage'] = outer._coverage_fn()
+                        if outer._slo_fn is not None:
+                            blob['slo'] = outer._slo_fn()
                         self._reply(200, 'application/json',
                                     json.dumps(blob, default=str))
                     elif route == '/coverage':
@@ -583,7 +655,7 @@ class DebugServer:
                     else:
                         self._reply(404, 'text/plain',
                                     'unknown route {}; try /healthz /metrics '
-                                    '/diagnostics /coverage /profile '
+                                    '/diagnostics /coverage /profile /slo '
                                     '/stacks\n'.format(route))
                 except Exception as e:  # report, never kill the serve loop
                     logger.exception('debug endpoint request failed')
@@ -602,7 +674,7 @@ class DebugServer:
                                         name='petastorm-tpu-debug-http')
         self._thread.start()
         logger.info('petastorm_tpu debug endpoint on http://127.0.0.1:%d '
-                    '(/healthz /metrics /diagnostics /profile /stacks)',
+                    '(/healthz /metrics /diagnostics /profile /slo /stacks)',
                     self.port)
         return self
 

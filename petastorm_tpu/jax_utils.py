@@ -268,6 +268,11 @@ class JaxLoaderBase(object):
         #: health=loader.health)`` so the prefetch thread heartbeats onto the
         #: same watchdog as the rest of the pipeline.
         self.health = getattr(reader, 'health', None)
+        #: The reader pool's ``ReaderStats`` (None for readers without one).
+        #: When its latency plane is on, the iteration loop records
+        #: ``infeed_wait``/``train_step`` duration histograms even with
+        #: tracing off — tail latencies must not require a span ring.
+        self.stats = getattr(reader, 'stats', None)
 
     def __iter__(self):
         if self._error is not None:
@@ -281,27 +286,38 @@ class JaxLoaderBase(object):
                            'in-memory caching (inmemory_cache_all=True).')
         self._in_iter = True
         tracer = self.tracer
+        latency = getattr(self.stats, 'latency', None) \
+            if self.stats is not None else None
         try:
-            if tracer is None:
+            if tracer is None and latency is None:
                 for batch in self._iter_impl():
                     yield batch
             else:
                 it = self._iter_impl()
+                fetch_start = time.perf_counter()
                 while True:
-                    fetch_start = time.perf_counter()
                     try:
                         batch = next(it)
                     except StopIteration:
                         break
                     now = time.perf_counter()
-                    tracer.add_span('infeed_wait', 'consumer', fetch_start,
-                                    now - fetch_start)
-                    step_start = time.perf_counter()
+                    if latency is not None:
+                        latency.record('infeed_wait', now - fetch_start)
+                    if tracer is not None:
+                        tracer.add_span('infeed_wait', 'consumer',
+                                        fetch_start, now - fetch_start)
+                    step_start = now
                     yield batch
                     # the time the consumer held the generator suspended IS
-                    # its train step (plus any device sync inside it)
-                    tracer.add_span('train_step', 'consumer', step_start,
-                                    time.perf_counter() - step_start)
+                    # its train step (plus any device sync inside it);
+                    # the step's end doubles as the next fetch's start
+                    fetch_start = time.perf_counter()
+                    step_elapsed = fetch_start - step_start
+                    if latency is not None:
+                        latency.record('train_step', step_elapsed)
+                    if tracer is not None:
+                        tracer.add_span('train_step', 'consumer', step_start,
+                                        step_elapsed)
         except Exception as e:
             self._error = e
             raise
@@ -392,6 +408,16 @@ class JaxDataLoader(JaxLoaderBase):
         #: the loader gauges shuffle-buffer occupancy into it, and the
         #: device-staging helpers time ``jax.device_put`` against it.
         self.stats = getattr(reader, 'stats', None)
+        #: End-to-end batch latency (ventilate → finished batch): recorded
+        #: here — the LAST delivery point — via the packed lineage sources,
+        #: so the reader's own per-item e2e recording defers to the loader
+        #: (one observation per delivered unit, never double-counted).
+        self._e2e_on = (self._lineage_on
+                        and getattr(self.stats, 'latency', None) is not None)
+        if self._e2e_on:
+            defer = getattr(reader, '_defer_e2e_to_loader', None)
+            if defer is not None:
+                defer()
 
     def _cache_hot(self):
         return self._cache_complete
@@ -435,6 +461,18 @@ class JaxDataLoader(JaxLoaderBase):
                 batch = self.transform_fn(batch)
             if sources is not None and isinstance(batch, dict):
                 batch[PROVENANCE_KEY] = BatchProvenance(sources, self._lineage)
+                if self._e2e_on and len(sources):
+                    # ventilate timestamp of the batch's oldest source item
+                    # → now: the end-to-end latency of this delivery,
+                    # correlated through the lineage seqs the provenance
+                    # column already carries. The smallest seq IS the
+                    # earliest-registered item (one min, no unique/sort on
+                    # the per-batch path).
+                    ts = self._lineage.ventilated_ts(
+                        int(np.asarray(sources).min()) >> PACK_SHIFT)
+                    if ts is not None:
+                        self.stats.record_latency(
+                            'e2e_batch', time.perf_counter() - ts)
             if self._cache is not None:
                 self._cache.append(batch)
             yield batch
@@ -760,13 +798,15 @@ def stage_to_global(batch, named_sharding, stats=None, tracer=None):
         elapsed = time.perf_counter() - start
         if stats is not None:
             stats.add_time('device_stage_s', elapsed)
+            stats.record_latency('device_stage', elapsed)
         if tracer is not None:
             tracer.add_span('device_stage', 'device', start, elapsed)
     return device
 
 
 def infeed_diagnosis(snapshot: dict, heartbeats=None,
-                     stall_after_s=None, roofline=None) -> dict:
+                     stall_after_s=None, roofline=None, latency=None,
+                     slo=None) -> dict:
     """Classify an infeed pipeline from a ``ReaderStats`` snapshot
     (``reader.diagnostics`` / ``loader.stats.snapshot()``) and recommend the
     knobs that attack its bottleneck.
@@ -797,6 +837,13 @@ def infeed_diagnosis(snapshot: dict, heartbeats=None,
     calibrated binding-stage ceiling — so the diagnosis says not only
     *which* stage binds but *how far from the host's measured limit* the
     pipeline runs (see ``docs/profiling.md``).
+
+    ``latency`` (a :class:`~petastorm_tpu.latency.PipelineLatency`, e.g.
+    ``reader.stats.latency``) adds a ``latency`` section of per-stage
+    percentile summaries; the snapshot's derived ``queue_wait_p50_s`` /
+    ``queue_wait_p99_s`` / ``e2e_latency_p99_s`` keys are surfaced either
+    way. ``slo`` (an :class:`~petastorm_tpu.latency.SLOMonitor` verdict)
+    embeds the SLO burn accounting (see ``docs/latency.md``).
     """
     from petastorm_tpu.health import (DEFAULT_STALL_AFTER_S,
                                       bottleneck_signals, classify_pipeline)
@@ -817,8 +864,17 @@ def infeed_diagnosis(snapshot: dict, heartbeats=None,
         'rows_decoded_batched': snapshot.get('rows_decoded_batched', 0),
         'rows_decoded_percell': snapshot.get('rows_decoded_percell', 0),
         'batched_decode_fraction': batched_decode_fraction(snapshot),
+        'queue_wait_p50_s': round(snapshot.get('queue_wait_p50_s', 0.0), 6),
+        'queue_wait_p99_s': round(snapshot.get('queue_wait_p99_s', 0.0), 6),
+        'e2e_latency_p99_s': round(snapshot.get('e2e_latency_p99_s', 0.0), 6),
         'hint': signals['hint'],
     }
+    if signals.get('tail_stall'):
+        out['tail_stall'] = True
+    if latency is not None:
+        out['latency'] = latency.summary()
+    if slo is not None:
+        out['slo'] = slo
     if heartbeats is not None:
         verdict = classify_pipeline(
             heartbeats, snapshot,
@@ -961,6 +1017,7 @@ def prefetch_to_device(iterator, size=2, sharding=None, stats=None,
             elapsed = time.perf_counter() - start
             if stats is not None:
                 stats.add_time('device_stage_s', elapsed)
+                stats.record_latency('device_stage', elapsed)
             if tracer is not None:
                 tracer.add_span('device_stage', 'device', start, elapsed)
         return staged

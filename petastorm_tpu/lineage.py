@@ -224,7 +224,8 @@ class LineageTracker:
                  row_filtered: bool = False,
                  record_capacity: int = DEFAULT_RECORD_CAPACITY,
                  epoch_capacity: int = DEFAULT_EPOCH_CAPACITY,
-                 quarantine_capacity: int = DEFAULT_QUARANTINE_CAPACITY):
+                 quarantine_capacity: int = DEFAULT_QUARANTINE_CAPACITY,
+                 record_vent_ts: bool = False):
         self.enabled = enabled
         self.dataset_digest = dataset_digest
         self.shard = shard
@@ -238,8 +239,15 @@ class LineageTracker:
         self.items = [(int(i), tuple(p)) for i, p in (items or [])]
         self._record_capacity = record_capacity
         self._epoch_capacity = epoch_capacity
+        #: When set (the reader wires it iff the latency plane is on), each
+        #: ventilation stamps a monotonic timestamp that :meth:`register`
+        #: correlates to the delivered item's ``seq`` — the start anchor of
+        #: the end-to-end batch-latency histogram (``docs/latency.md``).
+        self._record_vent_ts = bool(enabled and record_vent_ts)
         self._lock = threading.Lock()
         self._records: 'collections.OrderedDict[int, Provenance]' = \
+            collections.OrderedDict()
+        self._vent_ts: 'collections.OrderedDict[int, float]' = \
             collections.OrderedDict()
         self._next_seq = 0
         # epoch -> {'ventilated': Counter, 'vent_order': [key],
@@ -261,7 +269,7 @@ class LineageTracker:
         if entry is None:
             entry = {'ventilated': collections.Counter(), 'vent_order': [],
                      'delivered': {}, 'order': [], 'rows': 0,
-                     'quarantined': collections.Counter()}
+                     'quarantined': collections.Counter(), 'vent_ts': {}}
             self._epochs[epoch] = entry
             while len(self._epochs) > self._epoch_capacity:
                 self._epochs.popitem(last=False)
@@ -278,6 +286,12 @@ class LineageTracker:
             entry = self._epoch_entry(epoch)
             entry['ventilated'][key] += 1
             entry['vent_order'].append(key)
+            if self._record_vent_ts:
+                # FIFO of dispatch timestamps per key: re-ventilations of the
+                # same item (multi-epoch keys live in separate epoch entries)
+                # consume in dispatch order at register() time
+                entry['vent_ts'].setdefault(key, []).append(
+                    time.perf_counter())
 
     def register(self, record: Provenance) -> int:
         """Register one delivered item's provenance; returns its ``seq``
@@ -290,6 +304,12 @@ class LineageTracker:
             while len(self._records) > self._record_capacity:
                 self._records.popitem(last=False)
             entry = self._epoch_entry(record.epoch)
+            if self._record_vent_ts:
+                ts_fifo = entry['vent_ts'].get(key)
+                if ts_fifo:
+                    self._vent_ts[seq] = ts_fifo.pop(0)
+                    while len(self._vent_ts) > self._record_capacity:
+                        self._vent_ts.popitem(last=False)
             entry['delivered'].setdefault(key, []).append(record)
             entry['order'].append(key)
             entry['rows'] += record.rows
@@ -302,6 +322,15 @@ class LineageTracker:
             return None
         with self._lock:
             return self._records.get(int(seq))
+
+    def ventilated_ts(self, seq) -> Optional[float]:
+        """Monotonic dispatch timestamp of the item registered as ``seq``
+        (``None`` when vent-ts tracking is off, the record was ring-evicted,
+        or the ventilation predated the tracker)."""
+        if seq is None:
+            return None
+        with self._lock:
+            return self._vent_ts.get(int(seq))
 
     def add_quarantines(self, records) -> None:
         """Absorb quarantine records shipped back by a pool."""
@@ -740,7 +769,8 @@ def replay_records(reader, records: List[Provenance],
     if worker_class is None or worker_args is None:
         raise RuntimeError('reader does not expose replay machinery')
     args = dict(worker_args)
-    args.update(trace=False, health=False, lineage=False, io_readahead=0)
+    args.update(trace=False, health=False, lineage=False, latency=False,
+                io_readahead=0)
     collector = _ReplayCollector()
     worker = worker_class(-1, collector, args)
     pieces_out = []

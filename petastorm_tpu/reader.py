@@ -182,7 +182,8 @@ def make_reader(dataset_url,
                 profiling_enabled=False, decode_hints=None,
                 io_readahead=0, trace=None, metrics_interval=0,
                 metrics_out=None, debug_port=None, stall_timeout=0,
-                flight_record_dir=None, on_decode_error='raise'):
+                flight_record_dir=None, on_decode_error='raise',
+                slo=None):
     """Row-granular reader for petastorm_tpu datasets (codec-decoded rows).
 
     Mirrors the reference factory (``reader.py:61-195``). Raises a helpful error
@@ -267,7 +268,7 @@ def make_reader(dataset_url,
                   metrics_interval=metrics_interval, metrics_out=metrics_out,
                   debug_port=debug_port, stall_timeout=stall_timeout,
                   flight_record_dir=flight_record_dir,
-                  on_decode_error=on_decode_error)
+                  on_decode_error=on_decode_error, slo=slo)
 
 
 def make_columnar_reader(dataset_url,
@@ -286,7 +287,8 @@ def make_columnar_reader(dataset_url,
                          profiling_enabled=False, decode_hints=None,
                          io_readahead=0, trace=None, metrics_interval=0,
                          metrics_out=None, debug_port=None, stall_timeout=0,
-                         flight_record_dir=None, on_decode_error='raise'):
+                         flight_record_dir=None, on_decode_error='raise',
+                         slo=None):
     """Vectorized codec-decoded reader for petastorm_tpu datasets.
 
     Yields **batch namedtuples of decoded numpy column arrays** (one per row
@@ -338,7 +340,7 @@ def make_columnar_reader(dataset_url,
                   metrics_interval=metrics_interval, metrics_out=metrics_out,
                   debug_port=debug_port, stall_timeout=stall_timeout,
                   flight_record_dir=flight_record_dir,
-                  on_decode_error=on_decode_error)
+                  on_decode_error=on_decode_error, slo=slo)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -355,7 +357,7 @@ def make_batch_reader(dataset_url_or_urls,
                       profiling_enabled=False, io_readahead=0, trace=None,
                       metrics_interval=0, metrics_out=None, debug_port=None,
                       stall_timeout=0, flight_record_dir=None,
-                      on_decode_error='raise'):
+                      on_decode_error='raise', slo=None):
     """Vectorized batch reader for arbitrary parquet stores
     (reference ``reader.py:198-327``). Yields namedtuples of column arrays,
     one per row group. ``io_readahead`` prefetches upcoming row-group reads
@@ -391,7 +393,7 @@ def make_batch_reader(dataset_url_or_urls,
                   metrics_out=metrics_out, debug_port=debug_port,
                   stall_timeout=stall_timeout,
                   flight_record_dir=flight_record_dir,
-                  on_decode_error=on_decode_error)
+                  on_decode_error=on_decode_error, slo=slo)
 
 
 class Reader:
@@ -406,7 +408,8 @@ class Reader:
                  pool=None, is_batched_reader=False, decode_hints=None,
                  io_readahead=0, trace_export=None, metrics_interval=0,
                  metrics_out=None, debug_port=None, stall_timeout=0,
-                 flight_record_dir=None, on_decode_error='raise'):
+                 flight_record_dir=None, on_decode_error='raise',
+                 slo=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -422,6 +425,15 @@ class Reader:
             raise ValueError('stall_timeout must be >= 0, got '
                              '{!r}'.format(stall_timeout))
         validate_decode_error_policy(on_decode_error)
+        if slo:
+            # fail fast on a typo'd target name; the monitor itself is
+            # built after the pool (it reads the stats snapshot + latency)
+            from petastorm_tpu.latency import validate_slo_targets
+            slo = validate_slo_targets(slo)
+        #: The reader's :class:`~petastorm_tpu.latency.SLOMonitor`
+        #: (``None`` unless built with ``slo=dict(...)``); serves ``/slo``
+        #: and feeds the burn accounting from the watchdog tick.
+        self._slo = None
         self._filesystem_factory = filesystem_factory
         self._dataset_path = dataset_path
         self._pool = pool
@@ -567,7 +579,17 @@ class Reader:
             items=[(it['piece_index'],
                     tuple(it['shuffle_row_drop_partition'])) for it in items],
             row_filtered=(worker_predicate is not None
-                          or filters_predicate is not None))
+                          or filters_predicate is not None),
+            # ventilate timestamps anchor the end-to-end latency histogram;
+            # only stamped when the latency plane actually consumes them
+            record_vent_ts=getattr(pool.stats, 'latency', None) is not None)
+        #: End-to-end latency recording at ITEM delivery (one observation per
+        #: registered item). A JaxDataLoader defers this to its own batch
+        #: delivery point via :meth:`_defer_e2e_to_loader` so each delivered
+        #: unit is observed exactly once.
+        self._e2e_live = (self.lineage.enabled
+                          and getattr(pool.stats, 'latency', None) is not None)
+        self._last_e2e_seq = None
         self._worker_class = worker_class
         self._replay_items = {
             (it['piece_index'], tuple(it['shuffle_row_drop_partition'])): it
@@ -604,6 +626,7 @@ class Reader:
             'trace': tracer is not None,
             'health': self.health.enabled,
             'lineage': self.lineage.enabled,
+            'latency': getattr(pool.stats, 'latency', None) is not None,
             'on_decode_error': on_decode_error,
             'shard': cur_shard if cur_shard is not None else -1,
             'filesystem_factory': filesystem_factory,
@@ -630,7 +653,12 @@ class Reader:
                 self._stats_snapshot, metrics_interval, metrics_out)
             self._metrics_emitter.start()
 
-        # -- live health layer (see docs/health.md) ---------------------------
+        # -- live health + SLO layer (see docs/health.md, docs/latency.md) -----
+        if slo:
+            from petastorm_tpu.latency import SLOMonitor
+            self._slo = SLOMonitor(slo, snapshot_fn=self._stats_snapshot,
+                                   latency=getattr(pool.stats, 'latency',
+                                                   None))
         pool_heartbeats = getattr(pool, 'heartbeats', None)
         if pool_heartbeats is not None:
             self.health.add_source(pool_heartbeats)
@@ -638,11 +666,12 @@ class Reader:
         if stall_timeout or resolved_debug_port is not None:
             # on-demand verdicts (/healthz) use the default threshold when no
             # stall_timeout was configured; the background thread only runs
-            # when one was (it exists to fire the flight recorder)
+            # when one was (it exists to fire the flight recorder and to
+            # cadence the SLO burn accounting)
             self._watchdog = PipelineWatchdog(
                 self.health.heartbeats, pool.stats.snapshot,
                 stall_after_s=stall_timeout or DEFAULT_STALL_AFTER_S,
-                on_stall=self._on_stall)
+                on_stall=self._on_stall, slo_monitor=self._slo)
             if stall_timeout:
                 self._watchdog.start()
         if resolved_debug_port is not None:
@@ -653,7 +682,9 @@ class Reader:
                 coverage_fn=(self.lineage.coverage_report
                              if self.lineage.enabled else None),
                 profile_fn=(self._profile_route if profiler_enabled()
-                            else None))
+                            else None),
+                slo_fn=(self._slo.evaluate if self._slo is not None
+                        else None))
             try:
                 self._debug_server.start()
             except (OSError, OverflowError) as e:   # taken / out-of-range port
@@ -769,10 +800,26 @@ class Reader:
     def __next__(self):
         try:
             row = self._results_reader.read_next(self._pool)
-            return row
         except EmptyResultError:
             self.last_row_consumed = True
             raise StopIteration
+        if self._e2e_live:
+            # one end-to-end observation per delivered ITEM (row readers
+            # yield many rows per item: record on the seq edge only)
+            seq = self._results_reader.last_seq
+            if seq is not None and seq != self._last_e2e_seq:
+                self._last_e2e_seq = seq
+                ts = self.lineage.ventilated_ts(seq)
+                if ts is not None:
+                    self._pool.stats.record_latency(
+                        'e2e_batch', time.perf_counter() - ts)
+        return row
+
+    def _defer_e2e_to_loader(self):
+        """Called by ``JaxDataLoader`` when it takes over end-to-end latency
+        recording at its own (later) batch-delivery point — the reader's
+        per-item recording stops so each delivered unit is observed once."""
+        self._e2e_live = False
 
     def next(self):
         return self.__next__()
@@ -834,6 +881,9 @@ class Reader:
     # -- flight recorder -------------------------------------------------------
 
     def _on_stall(self, verdict):
+        if self._slo is not None:
+            # edge-triggered upstream: one episode per stall, however long
+            self._slo.record_stall_episode()
         try:
             path = self.dump_flight_record(verdict=verdict)
             logger.error('pipeline stalled; flight record written to %s', path)
@@ -864,12 +914,23 @@ class Reader:
         if self._last_profile is not None:
             from petastorm_tpu.profiler import roofline_summary
             roofline = roofline_summary(self._last_profile)
+        latency_plane = getattr(self._pool.stats, 'latency', None)
+        slo_verdict = None
+        if self._slo is not None:
+            try:
+                slo_verdict = self._slo.evaluate()
+            except Exception:
+                logger.exception('SLO evaluation failed for flight record')
         record = build_flight_record(verdict, self.health.heartbeats(),
                                      snapshot, queues, tracer=self.tracer,
                                      lineage=(self.lineage.flight_summary()
                                               if self.lineage.enabled
                                               else None),
-                                     roofline=roofline)
+                                     roofline=roofline,
+                                     latency=(latency_plane.flight_summary()
+                                              if latency_plane is not None
+                                              else None),
+                                     slo=slo_verdict)
         if path is None:
             import tempfile
             out_dir = self._flight_record_dir or tempfile.gettempdir()
@@ -1069,6 +1130,20 @@ class Reader:
         the live per-stage telemetry accumulator. The JAX loaders record
         device staging time into it; ``diagnostics`` snapshots it."""
         return getattr(self._pool, 'stats', None)
+
+    @property
+    def slo(self):
+        """The reader's :class:`~petastorm_tpu.latency.SLOMonitor` (``None``
+        unless built with ``slo=dict(...)``). ``reader.slo.evaluate()`` is
+        the on-demand verdict the ``/slo`` route serves."""
+        return self._slo
+
+    @property
+    def latency(self):
+        """The pool's :class:`~petastorm_tpu.latency.PipelineLatency` — the
+        per-stage streaming histograms (``None`` under the
+        ``PETASTORM_TPU_LATENCY=0`` kill switch)."""
+        return getattr(self._pool.stats, 'latency', None)
 
     @property
     def watchdog(self):

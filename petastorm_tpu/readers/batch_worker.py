@@ -177,8 +177,9 @@ class ArrowBatchWorker(ParquetPieceWorker):
         try:
             return self._apply_transform_impl(table)
         finally:
-            self.record_span('transform', 'decode', start,
-                             time.perf_counter() - start)
+            elapsed = time.perf_counter() - start
+            self.record_latency('decode', elapsed)
+            self.record_span('transform', 'decode', start, elapsed)
 
     def _apply_transform_impl(self, table: pa.Table) -> pa.Table:
         spec = self._transform_spec

@@ -554,6 +554,7 @@ class ColumnarWorker(ParquetPieceWorker):
         start = time.perf_counter()
         out = apply_columnar_transform(self._transform_spec,
                                        self._transformed_schema, columns)
-        self.record_span('transform', 'decode', start,
-                         time.perf_counter() - start)
+        elapsed = time.perf_counter() - start
+        self.record_latency('decode', elapsed)
+        self.record_span('transform', 'decode', start, elapsed)
         return out

@@ -364,8 +364,9 @@ class ParquetPieceWorker(WorkerBase):
             self.record_count('rows_decoded_batched', path_counts['batched'])
         if path_counts['percell']:
             self.record_count('rows_decoded_percell', path_counts['percell'])
-        self.record_span('decode_columns', 'decode', start,
-                         time.perf_counter() - start)
+        elapsed = time.perf_counter() - start
+        self.record_latency('decode', elapsed)
+        self.record_span('decode_columns', 'decode', start, elapsed)
         return out
 
     # -- lineage / quarantine ----------------------------------------------------

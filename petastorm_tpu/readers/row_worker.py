@@ -292,8 +292,9 @@ class RowGroupWorker(ParquetPieceWorker):
                 if field is not None:
                     raw[key] = _cast_partition_value(field, value)
             decoded.append(decode_row(raw, schema, self._decode_overrides))
-        self.record_span('decode_rows', 'decode', start,
-                         time.perf_counter() - start)
+        elapsed = time.perf_counter() - start
+        self.record_latency('decode', elapsed)
+        self.record_span('decode_rows', 'decode', start, elapsed)
         return decoded
 
     def _load_rows(self, piece) -> List[dict]:

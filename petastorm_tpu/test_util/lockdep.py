@@ -54,6 +54,7 @@ DEFAULT_TARGET_MODULES = (
     'petastorm_tpu.health',
     'petastorm_tpu.tracing',
     'petastorm_tpu.lineage',
+    'petastorm_tpu.latency',
     'petastorm_tpu.workers.thread_pool',
     'petastorm_tpu.workers.stats',
     'petastorm_tpu.workers.ventilator',

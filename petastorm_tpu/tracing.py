@@ -246,9 +246,29 @@ def prometheus_text(snapshot: dict, prefix: str = 'petastorm_tpu') -> str:
     One string key is special-cased: ``binding_stage`` (the roofline
     profiler's verdict — see ``docs/profiling.md``) exports as an
     info-style labeled gauge ``<prefix>_binding_stage{stage="decode"} 1``,
-    the Prometheus idiom for categorical state."""
+    the Prometheus idiom for categorical state.
+
+    When the snapshot carries the latency plane's histogram states (the
+    ``'_latency_histograms'`` key a ``ReaderStats`` snapshot includes unless
+    kill-switched — see ``docs/latency.md``), each stage renders in the
+    spec's **histogram** form: cumulative ``<prefix>_latency_<stage>_seconds_bucket``
+    samples with ``le`` labels, the mandatory terminal ``le="+Inf"`` bucket,
+    and ``_sum``/``_count`` — scrapeable by any Prometheus-conformant
+    parser, quantile-queryable via ``histogram_quantile()``."""
+    from petastorm_tpu.latency import prometheus_histogram_lines
+    from petastorm_tpu.workers.stats import LATENCY_HISTOGRAMS_KEY
     lines = []
+    histograms = snapshot.get(LATENCY_HISTOGRAMS_KEY)
+    if isinstance(histograms, dict):
+        for stage in sorted(histograms):
+            metric = '{}_latency_{}_seconds'.format(prefix, stage)
+            lines.extend(prometheus_histogram_lines(
+                metric, histograms[stage],
+                help_text='petastorm_tpu {} duration distribution '
+                          '(see docs/latency.md)'.format(stage)))
     for key in sorted(snapshot):
+        if key == LATENCY_HISTOGRAMS_KEY:
+            continue
         value = snapshot[key]
         if key == 'binding_stage' and isinstance(value, str) and value:
             metric = '{}_{}'.format(prefix, key)
@@ -320,6 +340,11 @@ class MetricsEmitter:
     def emit_once(self) -> None:
         snapshot = dict(self._snapshot_fn())
         if self._fmt == 'jsonl':
+            # jsonl lines stay scalar: the raw histogram states (137-bucket
+            # count pairs per stage per tick) belong to the .prom/scrape
+            # path; the derived *_p50_s/*_p99_s keys carry the tail signal
+            from petastorm_tpu.workers.stats import LATENCY_HISTOGRAMS_KEY
+            snapshot.pop(LATENCY_HISTOGRAMS_KEY, None)
             # deliberate wall clock: 'ts' is a log-pipeline timestamp for
             # humans and scrapers, never compared against monotonic readings
             ts = time.time()  # petalint: disable=monotonic-clock

@@ -64,6 +64,8 @@ class DummyPool:
                     counts, gauges = self._worker.drain_stat_counts()
                     self.stats.merge_counts(counts)
                     self.stats.merge_gauges(gauges)
+                if hasattr(self._worker, 'drain_latency'):
+                    self.stats.merge_latency(self._worker.drain_latency())
                 if hasattr(self._worker, 'drain_quarantines'):
                     quarantines = self._worker.drain_quarantines()
                     if quarantines and self.lineage is not None:

@@ -275,8 +275,10 @@ class ProcessPool:
                 deser_start = time.perf_counter()
                 with self.stats.timed('deserialize_s'):
                     result = self._serializer.deserialize_multipart(payload_frames)
+                now = time.perf_counter()
+                self.stats.record_latency('queue_wait', deser_start - entered)
+                self.stats.record_latency('deserialize', now - deser_start)
                 if self.tracer is not None:
-                    now = time.perf_counter()
                     self.tracer.add_span('queue_wait', 'consumer', entered,
                                          deser_start - entered)
                     self.tracer.add_span('deserialize', 'transport',
@@ -327,6 +329,7 @@ class ProcessPool:
         self.stats.merge_times(item_stats.get('times'))
         self.stats.merge_counts(item_stats.get('counts'))
         self.stats.merge_gauges(item_stats.get('gauges'))
+        self.stats.merge_latency(item_stats.get('latency'))
         self._merge_heartbeats(item_stats.get('heartbeats'))
         if self.lineage is not None and item_stats.get('quarantines'):
             self.lineage.add_quarantines(item_stats['quarantines'])
@@ -617,6 +620,12 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
                     item_stats['counts'] = counts
                 if gauges:
                     item_stats['gauges'] = gauges
+            if hasattr(worker, 'drain_latency'):
+                # bucket-count deltas ride the accounting message like
+                # merge_counts: worker death loses only unshipped deltas
+                latency_deltas = worker.drain_latency()
+                if latency_deltas:
+                    item_stats['latency'] = latency_deltas
             if hasattr(worker, 'drain_quarantines'):
                 quarantines = worker.drain_quarantines()
                 if quarantines:

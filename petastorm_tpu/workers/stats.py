@@ -21,6 +21,8 @@ import threading
 import time
 from contextlib import contextmanager
 
+from petastorm_tpu.latency import PipelineLatency, latency_enabled
+
 #: Wall-time stages, in pipeline order. All are seconds.
 TIME_STAGES = (
     'worker_io_s',       # storage stall inside the worker (inline reads +
@@ -68,8 +70,18 @@ GAUGES = ('queue_depth', 'shuffle_buffer_depth', 'readahead_depth',
 #: ``items_per_s``/``mb_per_s`` are rates over the snapshot window — the time
 #: since construction or the last :meth:`ReaderStats.reset` — so benchmarks
 #: that ``reset()`` after warmup read steady-state rates, and the metrics
-#: emitter / throughput CLI stop recomputing them ad hoc.
-DERIVED = ('io_overlap_fraction', 'window_s', 'items_per_s', 'mb_per_s')
+#: emitter / throughput CLI stop recomputing them ad hoc. The ``*_p50_s`` /
+#: ``*_p99_s`` keys are tail-latency estimates from the streaming histograms
+#: (``docs/latency.md``); 0.0 when the latency plane is disabled or has no
+#: observations yet.
+DERIVED = ('io_overlap_fraction', 'window_s', 'items_per_s', 'mb_per_s',
+           'queue_wait_p50_s', 'queue_wait_p99_s', 'e2e_latency_p99_s')
+
+#: Snapshot key carrying the raw per-stage histogram states (bucket-count
+#: pairs + sum/count) when the latency plane is on — what ``/metrics``
+#: renders as Prometheus histograms and flight records embed. Absent under
+#: the ``PETASTORM_TPU_LATENCY=0`` kill switch.
+LATENCY_HISTOGRAMS_KEY = '_latency_histograms'
 
 _MB = 1024.0 * 1024.0
 
@@ -78,10 +90,16 @@ class ReaderStats:
     """Thread-safe per-stage accumulator. All keys exist from construction so
     ``snapshot()`` has a stable schema regardless of pool type."""
 
-    __slots__ = ('_lock', '_times', '_counts', '_gauges', '_window_start')
+    __slots__ = ('_lock', '_times', '_counts', '_gauges', '_window_start',
+                 'latency')
 
     def __init__(self):
         self._lock = threading.Lock()
+        #: The per-stage tail-latency plane (:class:`PipelineLatency`), or
+        #: ``None`` under the ``PETASTORM_TPU_LATENCY=0`` kill switch — every
+        #: feed site is a single attribute test. Fed from the same timing
+        #: sites as the stage sums (see ``docs/latency.md``).
+        self.latency = PipelineLatency() if latency_enabled() else None
         self._init_locked()
 
     def _init_locked(self):
@@ -100,6 +118,24 @@ class ReaderStats:
         measured)."""
         with self._lock:
             self._init_locked()
+        if self.latency is not None:
+            self.latency.reset()
+
+    def record_latency(self, stage: str, seconds: float) -> None:
+        """Record one per-observation duration against a latency stage
+        (:data:`petastorm_tpu.latency.STAGES`); no-op when the latency plane
+        is disabled."""
+        latency = self.latency
+        if latency is not None:
+            latency.record(stage, seconds)
+
+    def merge_latency(self, deltas) -> None:
+        """Absorb a worker's drained ``{stage: bucket-delta}`` mapping
+        (shipped back in the accounting control message, exactly like
+        :meth:`merge_counts` — a dead worker loses only unshipped deltas)."""
+        latency = self.latency
+        if latency is not None and deltas:
+            latency.merge_deltas(deltas)
 
     def add_time(self, stage: str, seconds: float) -> None:
         with self._lock:
@@ -171,6 +207,22 @@ class ReaderStats:
         out['items_per_s'] = out['items_out'] / window if window > 0 else 0.0
         out['mb_per_s'] = (out['bytes_moved'] / _MB / window
                            if window > 0 else 0.0)
+        # tail-latency derived keys (computed outside the stats lock: the
+        # histograms carry their own locks and are never nested under it)
+        latency = self.latency
+        if latency is not None:
+            queue_wait = latency.histograms['queue_wait']
+            e2e = latency.histograms['e2e_batch']
+            out['queue_wait_p50_s'] = queue_wait.quantile(0.5) or 0.0
+            out['queue_wait_p99_s'] = queue_wait.quantile(0.99) or 0.0
+            out['e2e_latency_p99_s'] = e2e.quantile(0.99) or 0.0
+            state = latency.export_state()
+            if state:   # stages with observations only; never an empty key
+                out[LATENCY_HISTOGRAMS_KEY] = state
+        else:
+            out['queue_wait_p50_s'] = 0.0
+            out['queue_wait_p99_s'] = 0.0
+            out['e2e_latency_p99_s'] = 0.0
         return out
 
 

@@ -121,6 +121,8 @@ class WorkerThread(threading.Thread):
                     counts, gauges = self._worker.drain_stat_counts()
                     stats.merge_counts(counts)
                     stats.merge_gauges(gauges)
+                if hasattr(self._worker, 'drain_latency'):
+                    stats.merge_latency(self._worker.drain_latency())
                 if hasattr(self._worker, 'drain_quarantines'):
                     quarantines = self._worker.drain_quarantines()
                     if quarantines and self._pool.lineage is not None:
@@ -277,8 +279,12 @@ class ThreadPool:
                 raise item.exc
             self.stats.gauge('queue_depth', self._results_queue.qsize())
             self.stats.add('items_out')
+            now = time.perf_counter()
+            # full consumer wait for THIS delivery (the same interval the
+            # queue_wait span covers) — one histogram observation per item,
+            # not the 100ms-clamped poll slices queue_wait_s accumulates
+            self.stats.record_latency('queue_wait', now - entered)
             if self.tracer is not None:
-                now = time.perf_counter()
                 self.tracer.add_span('queue_wait', 'consumer', entered,
                                      now - entered)
             return item

@@ -5,6 +5,8 @@ import threading
 import time
 from abc import ABC, abstractmethod
 
+from petastorm_tpu.latency import LatencyDeltas
+
 
 class WorkerBase(ABC):
     """A worker processes ventilated items and emits 0..n results via
@@ -47,6 +49,15 @@ class WorkerBase(ABC):
         #: accumulate here until the owning pool drains them (accounting
         #: message for process pools, direct merge for in-process pools).
         self.lineage_enabled = isinstance(args, dict) and bool(args.get('lineage'))
+        #: Worker-side tail-latency accumulator (``None`` under the
+        #: ``PETASTORM_TPU_LATENCY=0`` kill switch): observations are
+        #: bucketed locally against the fixed geometric bounds and drained
+        #: as compact ``{stage: bucket-delta}`` dicts — process pools ship
+        #: them in the accounting control message exactly like the stage
+        #: times, so a dead worker loses only unshipped deltas.
+        self.latency = (LatencyDeltas()
+                        if isinstance(args, dict) and args.get('latency')
+                        else None)
         self.quarantine_records = []
         self.empty_publishes = []
         self._entity = 'worker-{}'.format(worker_id)
@@ -64,6 +75,10 @@ class WorkerBase(ABC):
         (see :mod:`petastorm_tpu.workers.stats` for the stage names). Also
         counts as a heartbeat: finishing a timed stage is progress."""
         self.stage_times[stage] = self.stage_times.get(stage, 0.0) + seconds
+        if self.latency is not None:
+            # one histogram observation per timed section (io read, decode
+            # pass) — per-observation durations, not the per-item sum
+            self.latency.record_time_stage(stage, seconds)
         if self.health_enabled:
             self.beat(stage[:-2] if stage.endswith('_s') else stage)
 
@@ -115,6 +130,23 @@ class WorkerBase(ABC):
         counts, self.stat_counts = self.stat_counts, {}
         gauges, self.stat_gauges = self.stat_gauges, {}
         return counts, gauges
+
+    def record_latency(self, stage: str, seconds: float) -> None:
+        """Record one duration observation against a latency stage (see
+        :data:`petastorm_tpu.latency.STAGES`) — used by the decode sites
+        whose durations only the tracer spans measured before (span
+        recording is gated on tracing; tail latencies must not be). No-op
+        under the kill switch."""
+        if self.latency is not None:
+            self.latency.record(stage, seconds)
+
+    def drain_latency(self):
+        """Return and reset the accumulated latency bucket deltas
+        (``None`` when the plane is off or nothing was recorded); same drain
+        discipline as :meth:`drain_stage_times`."""
+        if self.latency is None:
+            return None
+        return self.latency.drain()
 
     def record_quarantine(self, record: dict) -> None:
         """Accumulate one bad-sample quarantine record (see

@@ -202,6 +202,36 @@ class TestThreadStacksAndFlightRecord:
         assert blob['span_tail'][0]['name'] == 'decode_columns'
         assert any('MainThread' in name for name in blob['stacks'])
 
+    def test_flight_record_carries_latency_trend_and_slo(self, tmp_path):
+        """A stall dump must show whether the episode was a cliff or a
+        creep: the latency section embeds per-stage percentiles plus the
+        last K per-interval p99 snapshots, and the SLO verdict records the
+        burn state at the moment of death (docs/latency.md)."""
+        from petastorm_tpu.latency import PipelineLatency, SLOMonitor
+        clock_t = [0.0]
+        plane = PipelineLatency(interval_s=1.0, window_intervals=4,
+                                clock=lambda: clock_t[0])
+        # a creep: each interval's e2e p99 is worse than the last
+        for step, value in enumerate((0.01, 0.05, 0.4)):
+            clock_t[0] = float(step)
+            plane.record('e2e_batch', value)
+        clock_t[0] = 3.0
+        monitor = SLOMonitor({'p99_e2e_ms': 1.0, 'error_budget': 0.5,
+                              'min_evaluations': 1}, latency=plane)
+        slo_verdict = monitor.evaluate({})
+        heartbeats = {'worker-0': _record('decode', age_s=5.0)}
+        verdict = classify_pipeline(heartbeats, stall_after_s=1.0)
+        record = build_flight_record(verdict, heartbeats,
+                                     latency=plane.flight_summary(),
+                                     slo=slo_verdict)
+        path = write_flight_record(str(tmp_path / 'flight.json'), record)
+        blob = json.load(open(path))
+        trend = blob['latency']['p99_trend']['e2e_batch']
+        assert len(trend) == 3
+        assert trend[0] < trend[1] < trend[2], 'the creep must be visible'
+        assert blob['latency']['stages']['e2e_batch']['count'] == 3
+        assert blob['slo']['breached'] and blob['slo']['hard_breach']
+
 
 class _PoolConsumer:
     """Drains pool.get_results on a background thread (a wedged pipeline

@@ -1,0 +1,501 @@
+"""Tail-latency plane tests: streaming-histogram math (merge associativity,
+quantile error bounds, rolling windows), worker-side delta shipping across the
+process boundary (including a killed worker), the end-to-end SLO breach →
+error-budget burn → ``/slo``/``/healthz`` path, and the
+``PETASTORM_TPU_LATENCY=0`` kill switch's no-histogram-state contract."""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.latency import (BUCKET_BOUNDS_S, LATENCY_ENV_VAR,
+                                   NUM_BUCKETS, QUANTILE_REL_ERROR_BOUND,
+                                   STAGES, LatencyDeltas, LatencyHistogram,
+                                   PipelineLatency, SLOMonitor, bucket_index,
+                                   latency_enabled,
+                                   prometheus_histogram_lines,
+                                   validate_slo_targets)
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.test_util.dataset_gen import create_test_dataset
+from petastorm_tpu.workers.stats import LATENCY_HISTOGRAMS_KEY, ReaderStats
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestBucketScheme:
+    def test_boundaries_are_fixed_and_geometric(self):
+        bounds = np.asarray(BUCKET_BOUNDS_S)
+        ratios = bounds[1:] / bounds[:-1]
+        assert np.allclose(ratios, ratios[0])
+        # mergeability rests on every instance sharing these: they are
+        # module constants, never per-instance configuration
+        assert len(bounds) == NUM_BUCKETS
+
+    def test_bucket_index_boundary_exact(self):
+        # v == bound must land IN that bucket (le semantics), v just above
+        # in the next — across the whole range, despite float log noise
+        for i, bound in enumerate(BUCKET_BOUNDS_S):
+            assert bucket_index(bound) == i
+            assert bucket_index(bound * 1.0000001) == i + 1
+        assert bucket_index(0.0) == 0
+        assert bucket_index(-1.0) == 0
+        assert bucket_index(1e9) == NUM_BUCKETS   # overflow bucket
+
+    @pytest.mark.parametrize('dist', ['lognormal', 'uniform', 'bimodal'])
+    def test_quantile_error_bound_vs_numpy(self, dist):
+        rng = np.random.default_rng(7)
+        if dist == 'lognormal':
+            vals = rng.lognormal(-5.0, 1.5, 20000)
+        elif dist == 'uniform':
+            vals = rng.uniform(1e-4, 0.5, 20000)
+        else:
+            vals = np.concatenate([rng.normal(0.001, 1e-4, 10000),
+                                   rng.normal(0.2, 0.01, 200)])
+            vals = np.clip(vals, 1e-6, None)
+        histogram = LatencyHistogram()
+        for v in vals:
+            histogram.record(float(v))
+        for q in (0.5, 0.9, 0.99, 0.999):
+            estimated = histogram.quantile(q)
+            exact = float(np.percentile(vals, q * 100))
+            assert abs(estimated - exact) / exact <= QUANTILE_REL_ERROR_BOUND, \
+                (dist, q, estimated, exact)
+
+    def test_empty_histogram_quantile_none(self):
+        assert LatencyHistogram().quantile(0.99) is None
+        assert LatencyHistogram().percentiles()['p50'] is None
+
+
+class TestMerge:
+    def test_merge_associative_and_equals_direct_recording(self):
+        rng = np.random.default_rng(3)
+        vals = rng.lognormal(-6.0, 2.0, 3000)
+        direct = LatencyHistogram()
+        parts = [LatencyHistogram() for _ in range(3)]
+        for i, v in enumerate(vals):
+            direct.record(float(v))
+            parts[i % 3].record(float(v))
+        merged_fwd = LatencyHistogram()
+        for part in parts:
+            merged_fwd.merge(part)
+        merged_rev = LatencyHistogram()
+        for part in reversed(parts):
+            merged_rev.merge(part)
+        # bucket-count addition is commutative/associative; both orders
+        # equal recording everything into one instance
+        assert np.array_equal(merged_fwd.counts(), merged_rev.counts())
+        assert np.array_equal(merged_fwd.counts(), direct.counts())
+        assert merged_fwd.count == direct.count == len(vals)
+        assert merged_fwd.sum_s == pytest.approx(direct.sum_s)
+
+    def test_merge_delta_equals_merge(self):
+        vals = [1e-5, 3e-4, 0.02, 0.02, 1.5]
+        deltas = LatencyDeltas()
+        direct = LatencyHistogram()
+        for v in vals:
+            deltas.record('io', v)
+            direct.record(v)
+        drained = deltas.drain()
+        via_delta = LatencyHistogram()
+        via_delta.merge_delta(drained['io'])
+        assert np.array_equal(via_delta.counts(), direct.counts())
+        assert via_delta.count == direct.count
+        assert via_delta.sum_s == pytest.approx(direct.sum_s)
+        # drain resets; empty drain is None (nothing ships on idle items)
+        assert deltas.drain() is None
+
+    def test_deltas_map_time_stage_names(self):
+        deltas = LatencyDeltas()
+        deltas.record_time_stage('worker_io_s', 0.01)
+        deltas.record_time_stage('worker_decode_s', 0.02)
+        deltas.record_time_stage('serialize_s', 0.03)   # not a latency stage
+        drained = deltas.drain()
+        assert set(drained) == {'io', 'decode'}
+
+
+class TestRollingWindow:
+    def test_old_observations_age_out(self):
+        clock = _FakeClock()
+        histogram = LatencyHistogram(interval_s=1.0, window_intervals=3,
+                                     clock=clock)
+        histogram.record(0.001)
+        clock.t = 1.5
+        histogram.record(0.002)
+        # both still inside the 3-interval window
+        assert histogram.window_counts().sum() == 2
+        clock.t = 10.0   # far beyond the window: silent intervals roll in
+        assert histogram.window_counts().sum() == 0
+        # lifetime counts never age
+        assert histogram.count == 2
+        assert histogram.quantile(0.5) is not None
+        assert histogram.quantile(0.5, window=True) is None
+
+    def test_window_quantile_covers_recent_only(self):
+        clock = _FakeClock()
+        histogram = LatencyHistogram(interval_s=1.0, window_intervals=2,
+                                     clock=clock)
+        for _ in range(100):
+            histogram.record(0.001)   # old regime
+        clock.t = 5.0
+        for _ in range(10):
+            histogram.record(1.0)     # recent regime
+        window_p50 = histogram.quantile(0.5, window=True)
+        lifetime_p50 = histogram.quantile(0.5)
+        assert window_p50 == pytest.approx(1.0, rel=0.25)
+        assert lifetime_p50 == pytest.approx(0.001, rel=0.25)
+
+    def test_recent_interval_p99_trend(self):
+        clock = _FakeClock()
+        histogram = LatencyHistogram(interval_s=1.0, window_intervals=4,
+                                     clock=clock)
+        for step, value in enumerate([0.001, 0.01, 0.1]):
+            clock.t = float(step)
+            histogram.record(value)
+        clock.t = 3.0
+        histogram.record(0.5)   # open interval: not in the closed trend yet
+        trend = histogram.recent_interval_p99s()
+        assert len(trend) == 3
+        # the creep is visible interval over interval
+        assert trend[0] < trend[1] < trend[2]
+
+
+class TestPipelineLatencyPlane:
+    def test_fixed_stage_set_and_export(self):
+        plane = PipelineLatency()
+        assert set(plane.histograms) == set(STAGES)
+        plane.record('io', 0.01)
+        plane.record('nonexistent-stage', 0.01)   # ignored, never raises
+        state = plane.export_state()
+        assert set(state) == {'io'}
+        assert state['io']['count'] == 1
+
+    def test_flight_summary_has_trend(self):
+        clock = _FakeClock()
+        plane = PipelineLatency(interval_s=1.0, window_intervals=4,
+                                clock=clock)
+        for step in range(3):
+            clock.t = float(step)
+            plane.record('e2e_batch', 0.01 * (step + 1))
+        clock.t = 3.0
+        summary = plane.flight_summary()
+        assert 'e2e_batch' in summary['stages']
+        assert summary['stages']['e2e_batch']['p99_s'] > 0
+        assert len(summary['p99_trend']['e2e_batch']) == 3
+
+
+class TestPrometheusHistogramLines:
+    def test_cumulative_buckets_and_terminals(self):
+        histogram = LatencyHistogram()
+        for v in (1e-5, 1e-5, 3e-3, 0.2, 9999.0):
+            histogram.record(v)
+        lines = prometheus_histogram_lines('x_seconds', histogram.state())
+        assert lines[0] == '# TYPE x_seconds histogram'
+        bucket_lines = [ln for ln in lines if '_bucket{' in ln]
+        counts = [int(ln.rsplit(' ', 1)[1]) for ln in bucket_lines]
+        assert counts == sorted(counts), 'bucket samples must be cumulative'
+        assert bucket_lines[-1].startswith('x_seconds_bucket{le="+Inf"}')
+        assert counts[-1] == 5
+        assert any(ln.startswith('x_seconds_sum ') for ln in lines)
+        assert lines[-1] == 'x_seconds_count 5'
+
+
+class TestSLOMonitor:
+    def test_unknown_target_rejected(self):
+        with pytest.raises(ValueError, match='unknown slo target'):
+            validate_slo_targets({'p99_e2e_msec': 5})
+        with pytest.raises(ValueError, match='error_budget'):
+            validate_slo_targets({'error_budget': 0.0})
+        with pytest.raises(ValueError, match='budget_window'):
+            validate_slo_targets({'budget_window': 0})
+
+    def test_latency_target_skips_without_data(self):
+        monitor = SLOMonitor({'p99_e2e_ms': 5.0}, latency=PipelineLatency())
+        verdict = monitor.evaluate({})
+        assert verdict['skipped_checks'] == ['p99_e2e_ms']
+        assert not verdict['breached']
+        # kill switch: no latency plane at all also skips, loudly
+        monitor = SLOMonitor({'p99_e2e_ms': 5.0}, latency=None)
+        assert monitor.evaluate({})['skipped_checks'] == ['p99_e2e_ms']
+
+    def test_breach_and_burn_accounting(self):
+        plane = PipelineLatency()
+        for _ in range(50):
+            plane.record('e2e_batch', 0.5)   # 500ms p99
+        monitor = SLOMonitor({'p99_e2e_ms': 10.0, 'error_budget': 0.5,
+                              'budget_window': 4, 'eval_interval_s': 0,
+                              'min_evaluations': 1}, latency=plane)
+        first = monitor.evaluate({})
+        assert first['breached']
+        assert first['breached_checks'] == ['p99_e2e_ms']
+        assert first['checks']['p99_e2e_ms']['measured_ms'] > 10.0
+        # 1/1 breaching over budget 0.5 → burn 2.0: hard breach
+        assert first['burn_rate'] == pytest.approx(2.0)
+        assert first['hard_breach']
+        # the ring is bounded by budget_window
+        for _ in range(10):
+            last = monitor.evaluate({})
+        assert last['evaluations'] == 4
+
+    def test_burn_recording_is_probe_rate_independent(self):
+        """Read-style observers (/healthz probes, /slo scrapes) evaluate
+        freely, but at most one burn sample per eval_interval_s is RECORDED
+        — a fast prober can neither flush breach samples out of the ring
+        nor multiply them."""
+        monitor = SLOMonitor({'min_samples_per_s': 100.0,
+                              'eval_interval_s': 3600.0,
+                              'min_evaluations': 1})
+        first = monitor.evaluate({'items_per_s': 1.0})   # breaching: recorded
+        assert first['evaluations'] == 1 and first['breached_evaluations'] == 1
+        # a storm of passing probes inside the interval records NOTHING:
+        # the breach sample cannot be diluted by probe frequency
+        for _ in range(50):
+            last = monitor.evaluate({'items_per_s': 500.0})
+        assert last['evaluations'] == 1
+        assert last['breached_evaluations'] == 1
+        assert last['burn_rate'] >= 1.0
+        # the fresh checks still reflect the CURRENT state
+        assert not last['breached']
+
+    def test_hard_breach_needs_warmup_grace(self):
+        """A cold pipeline's first breaching evaluation (rates still
+        ramping) must not read as a spent budget and 503 the pod."""
+        monitor = SLOMonitor({'min_samples_per_s': 100.0,
+                              'eval_interval_s': 0,
+                              'min_evaluations': 5})
+        verdict = monitor.evaluate({'items_per_s': 0.0})
+        assert verdict['breached']
+        assert verdict['burn_rate'] >= 1.0
+        assert not verdict['hard_breach'], 'grace must hold off hard_breach'
+        for _ in range(4):
+            verdict = monitor.evaluate({'items_per_s': 0.0})
+        assert verdict['evaluations'] == 5
+        assert verdict['hard_breach'], 'sustained breach past grace asserts'
+
+    def test_throughput_and_stall_targets(self):
+        monitor = SLOMonitor({'min_samples_per_s': 100.0,
+                              'max_stall_episodes': 0})
+        good = monitor.evaluate({'items_per_s': 500.0})
+        assert not good['breached']
+        bad = monitor.evaluate({'items_per_s': 3.0})
+        assert 'min_samples_per_s' in bad['breached_checks']
+        monitor.record_stall_episode()
+        stalled = monitor.evaluate({'items_per_s': 500.0})
+        assert 'max_stall_episodes' in stalled['breached_checks']
+        assert stalled['stall_episodes'] == 1
+
+
+@pytest.fixture(scope='module')
+def latency_dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('latency_ds')
+    url = 'file://' + str(path / 'ds')
+    create_test_dataset(url, range(64), num_files=2)
+    return url
+
+
+class TestReaderIntegration:
+    def test_thread_pool_populates_histograms(self, latency_dataset):
+        with make_reader(latency_dataset, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            rows = sum(1 for _ in reader)
+            assert rows == 64
+            summary = reader.latency.summary()
+            for stage in ('io', 'decode', 'queue_wait', 'e2e_batch'):
+                assert summary[stage]['count'] > 0, stage
+            snap = reader.stats.snapshot()
+            assert snap['queue_wait_p99_s'] > 0.0
+            assert snap['queue_wait_p99_s'] >= snap['queue_wait_p50_s']
+            assert snap['e2e_latency_p99_s'] > 0.0
+            assert LATENCY_HISTOGRAMS_KEY in snap
+
+    def test_process_pool_ships_bucket_deltas(self, latency_dataset):
+        with make_reader(latency_dataset, reader_pool_type='process',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            rows = sum(1 for _ in reader)
+            assert rows == 64
+            summary = reader.latency.summary()
+            # io/decode are recorded INSIDE the worker interpreters and only
+            # reach this process as shipped bucket-count deltas
+            assert summary['io']['count'] > 0
+            assert summary['decode']['count'] > 0
+            assert summary['deserialize']['count'] > 0
+            assert summary['queue_wait']['count'] > 0
+
+    @pytest.mark.timeout(120)
+    def test_killed_worker_loses_only_unshipped_deltas(self, latency_dataset):
+        """A worker killed mid-epoch: every delta shipped before the kill
+        survives in the consumer-side histograms (the merge_counts shipping
+        contract), and the pool still dies loudly."""
+        reader = make_reader(latency_dataset, reader_pool_type='process',
+                             workers_count=1, num_epochs=1,
+                             shuffle_row_groups=False)
+        try:
+            iterator = iter(reader)
+            # consume until at least one worker accounting message (which
+            # carries the bucket deltas) has drained — the first payload
+            # frame can arrive ahead of its accounting frame
+            deadline = time.monotonic() + 60
+            while 'io' not in reader.latency.summary():
+                next(iterator)
+                assert time.monotonic() < deadline, 'no delta shipped'
+            before = reader.latency.summary()
+            assert before['io']['count'] > 0
+            reader._pool._processes[0].kill()
+            with pytest.raises(RuntimeError):
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    next(iterator)
+            after = reader.latency.summary()
+            # nothing already shipped is lost
+            assert after['io']['count'] >= before['io']['count']
+        finally:
+            reader.stop()
+            reader.join()
+
+    def test_slo_breach_burns_budget_and_flips_healthz(self, latency_dataset,
+                                                       tmp_path):
+        """Inject a slow decode → p99 e2e breaches the target → /slo reports
+        the burn → /healthz flips 503 under fail_healthz. The whole
+        sensor-to-verdict path, end to end."""
+        from petastorm_tpu.transform import TransformSpec
+
+        def slow(row):
+            time.sleep(0.003)
+            return row
+
+        with make_reader(latency_dataset, reader_pool_type='thread',
+                         workers_count=1, num_epochs=1,
+                         shuffle_row_groups=False,
+                         transform_spec=TransformSpec(slow),
+                         slo=dict(p99_e2e_ms=0.01, error_budget=0.5,
+                                  fail_healthz=True, eval_interval_s=0,
+                                  min_evaluations=1),
+                         debug_port=0) as reader:
+            sum(1 for _ in reader)
+            port = reader.debug_port
+            slo = json.load(urllib.request.urlopen(
+                'http://127.0.0.1:%d/slo' % port))
+            assert slo['breached']
+            assert 'p99_e2e_ms' in slo['breached_checks']
+            assert slo['checks']['p99_e2e_ms']['measured_ms'] > 0.01
+            assert slo['burn_rate'] >= 1.0 and slo['hard_breach']
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen('http://127.0.0.1:%d/healthz' % port)
+            assert err.value.code == 503
+            body = json.loads(err.value.read())
+            assert body['slo']['hard_breach']
+            # the flight record carries the latency + slo evidence
+            path = reader.dump_flight_record(str(tmp_path / 'flight.json'))
+            blob = json.load(open(path))
+            assert blob['slo']['hard_breach']
+            assert 'p99_trend' in blob['latency']
+            assert blob['latency']['stages']['e2e_batch']['count'] > 0
+
+    def test_healthz_stays_200_without_fail_healthz(self, latency_dataset):
+        with make_reader(latency_dataset, reader_pool_type='thread',
+                         workers_count=1, num_epochs=1,
+                         shuffle_row_groups=False,
+                         slo=dict(p99_e2e_ms=1e-9, error_budget=0.01,
+                                  eval_interval_s=0, min_evaluations=1),
+                         debug_port=0) as reader:
+            sum(1 for _ in reader)
+            port = reader.debug_port
+            slo = json.load(urllib.request.urlopen(
+                'http://127.0.0.1:%d/slo' % port))
+            assert slo['hard_breach']   # target is unmeetable on purpose
+            response = urllib.request.urlopen(
+                'http://127.0.0.1:%d/healthz' % port)
+            assert response.status == 200   # contract breach != liveness
+
+    def test_slo_route_404_without_targets(self, latency_dataset):
+        with make_reader(latency_dataset, reader_pool_type='thread',
+                         workers_count=1, num_epochs=1,
+                         shuffle_row_groups=False, debug_port=0) as reader:
+            sum(1 for _ in reader)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    'http://127.0.0.1:%d/slo' % reader.debug_port)
+            assert err.value.code == 404
+
+    def test_unknown_slo_target_fails_factory(self, latency_dataset):
+        with pytest.raises(ValueError, match='unknown slo target'):
+            make_reader(latency_dataset, slo=dict(p99_latency=5))
+
+    def test_loader_records_e2e_once_per_batch(self, latency_dataset):
+        from petastorm_tpu.jax_utils import JaxDataLoader
+        with make_reader(latency_dataset, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            assert reader._e2e_live
+            loader = JaxDataLoader(reader, batch_size=8)
+            # the loader takes over the (later) batch-delivery point
+            assert not reader._e2e_live
+            batches = sum(1 for _ in loader)
+            e2e = reader.latency.histograms['e2e_batch']
+            assert e2e.count == batches
+            infeed = reader.latency.histograms['infeed_wait']
+            assert infeed.count == batches
+
+    def test_kill_switch_creates_no_histogram_state(self, latency_dataset,
+                                                    monkeypatch):
+        monkeypatch.setenv(LATENCY_ENV_VAR, '0')
+        assert not latency_enabled()
+        assert ReaderStats().latency is None
+        with make_reader(latency_dataset, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            rows = sum(1 for _ in reader)
+            assert rows == 64
+            assert reader.latency is None
+            assert reader._worker_args['latency'] is False
+            assert not reader._e2e_live
+            for worker in reader._pool._workers:
+                assert worker.latency is None
+            snap = reader.stats.snapshot()
+            assert LATENCY_HISTOGRAMS_KEY not in snap
+            assert snap['queue_wait_p50_s'] == 0.0
+            assert snap['queue_wait_p99_s'] == 0.0
+            assert snap['e2e_latency_p99_s'] == 0.0
+
+    def test_slo_monitor_works_under_kill_switch(self, latency_dataset,
+                                                 monkeypatch):
+        """Throughput targets still evaluate without the latency plane;
+        latency targets skip loudly instead of silently passing."""
+        monkeypatch.setenv(LATENCY_ENV_VAR, '0')
+        with make_reader(latency_dataset, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False,
+                         slo=dict(p99_e2e_ms=5.0,
+                                  min_samples_per_s=0.001)) as reader:
+            sum(1 for _ in reader)
+            verdict = reader.slo.evaluate()
+            assert verdict['skipped_checks'] == ['p99_e2e_ms']
+            assert verdict['checks']['min_samples_per_s']['ok']
+
+
+class TestBottleneckTailStall:
+    def test_tail_stall_discriminated_from_steady_backpressure(self):
+        from petastorm_tpu.health import bottleneck_signals
+        base = {'worker_io_s': 1.0, 'worker_decode_s': 1.0}
+        steady = bottleneck_signals(dict(base, queue_wait_p50_s=0.2,
+                                         queue_wait_p99_s=0.3))
+        assert not steady['tail_stall']
+        tail = bottleneck_signals(dict(base, queue_wait_p50_s=0.0005,
+                                       queue_wait_p99_s=0.4))
+        assert tail['tail_stall']
+        assert tail['bottleneck'] == 'tail-stall'
+        assert 'p99' in tail['hint']
+        # no histogram keys at all (hand-built snapshot): never fires
+        plain = bottleneck_signals(base)
+        assert not plain['tail_stall']

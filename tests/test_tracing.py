@@ -407,6 +407,77 @@ class TestPrometheusText:
         assert 'petastorm_tpu_ok' in text
 
 
+#: One histogram bucket sample: ``name_bucket{le="<float or +Inf>"} <int>``
+#: — the conformance shape `histogram_quantile()` queries depend on.
+_PROM_BUCKET = __import__('re').compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{le="'
+    r'((?:[0-9.]+(?:e-?[0-9]+)?)|\+Inf)"\} ([0-9]+)$')
+
+
+class TestPrometheusHistogramConformance:
+    """The latency plane's histogram rendering, held to the exposition
+    format's histogram contract: ``# TYPE ... histogram``, cumulative
+    ``_bucket`` samples with increasing ``le``, a terminal ``le="+Inf"``
+    bucket equal to ``_count``, and ``_sum``/``_count`` lines."""
+
+    def _text_with_histograms(self):
+        from petastorm_tpu.latency import PipelineLatency
+        from petastorm_tpu.workers.stats import LATENCY_HISTOGRAMS_KEY
+        plane = PipelineLatency()
+        for v in (1e-5, 4e-4, 4e-4, 0.03, 2.5):
+            plane.record('queue_wait', v)
+        plane.record('e2e_batch', 0.25)
+        snapshot = {'items_out': 6, 'window_s': 1.0,
+                    LATENCY_HISTOGRAMS_KEY: plane.export_state()}
+        return prometheus_text(snapshot)
+
+    def test_histogram_blocks_parse_and_are_cumulative(self):
+        text = self._text_with_histograms()
+        lines = text.strip().splitlines()
+        assert ('# TYPE petastorm_tpu_latency_queue_wait_seconds histogram'
+                in lines)
+        for metric in ('queue_wait', 'e2e_batch'):
+            name = 'petastorm_tpu_latency_{}_seconds'.format(metric)
+            buckets = []
+            for line in lines:
+                match = _PROM_BUCKET.match(line)
+                if match and match.group(1) == name:
+                    buckets.append((match.group(2), int(match.group(3))))
+            assert buckets, name
+            counts = [count for _, count in buckets]
+            assert counts == sorted(counts), 'cumulative le samples'
+            les = [le for le, _ in buckets]
+            assert les[-1] == '+Inf', 'terminal +Inf bucket is mandatory'
+            finite = [float(le) for le in les[:-1]]
+            assert finite == sorted(finite), 'le labels must increase'
+            count_line = [ln for ln in lines
+                          if ln.startswith(name + '_count ')]
+            assert count_line and int(count_line[0].split()[1]) == counts[-1]
+            assert any(ln.startswith(name + '_sum ') for ln in lines)
+
+    def test_raw_state_key_never_leaks_as_gauge(self):
+        text = self._text_with_histograms()
+        assert '_latency_histograms' not in text
+        # the plain gauges still render beside the histogram blocks
+        assert 'petastorm_tpu_items_out 6' in text
+
+    def test_reader_stats_snapshot_renders_histograms(self):
+        from petastorm_tpu.workers.stats import ReaderStats
+        stats = ReaderStats()
+        if stats.latency is None:
+            import pytest
+            pytest.skip('latency plane disabled in this environment')
+        stats.record_latency('queue_wait', 0.01)
+        text = prometheus_text(stats.snapshot())
+        assert ('petastorm_tpu_latency_queue_wait_seconds_bucket{le="+Inf"} 1'
+                in text)
+        # every non-histogram sample line still parses
+        for line in text.strip().splitlines():
+            if line.startswith('#') or '_bucket{' in line:
+                continue
+            assert _PROM_SAMPLE.match(line), line
+
+
 class TestAtomicExports:
     def test_chrome_trace_export_is_atomic(self, tmp_path):
         tracer = Tracer()
