@@ -310,6 +310,15 @@ def main(argv=None):
         line.pop('runs', None)
         line.pop('roofline', None)
 
+    # -- autotune: mis-tuned recovery + steady guard ------------------------
+    # Quick mode asserts the controller's own graded move helped and the
+    # steady guard held; the headline recovery record lives in
+    # BENCH_r15.json from the full run.
+    from petastorm_tpu.benchmark.autotune import run_autotune_bench
+    autotune_bench = run_autotune_bench(quick=True)
+    # per-sample detail is artifact material, not headline JSON
+    autotune_bench.get('recovered', {}).pop('timeline', None)
+
     # -- north-star: train-step infeed overlap ------------------------------
     # Accelerator-scale configs for any non-CPU backend; dataset paths carry
     # the size parameters so a platform change can't reuse a stale store.
@@ -500,6 +509,7 @@ def main(argv=None):
         'shared_cache': shared_cache,
         'roofline_bench': roofline_bench,
         'decode_batch': decode_batch,
+        'autotune': autotune_bench,
         'northstar': {
             'platform': platform,
             'mnist_train': _with_roofline(mnist.as_dict(), mnist_roofline),

@@ -142,7 +142,7 @@ class MonotonicClockRule(Rule):
     SCOPE = ('petastorm_tpu/health.py', 'petastorm_tpu/tracing.py',
              'petastorm_tpu/sharedcache.py', 'petastorm_tpu/lineage.py',
              'petastorm_tpu/latency.py', 'petastorm_tpu/profiler.py',
-             'petastorm_tpu/workers/*',
+             'petastorm_tpu/autotune.py', 'petastorm_tpu/workers/*',
              'petastorm_tpu/readers/readahead.py')
     _WALL_CALLS = ('time.time', 'datetime.now', 'datetime.datetime.now',
                    'datetime.utcnow', 'datetime.datetime.utcnow')

@@ -44,7 +44,7 @@ ANNOTATION = re.compile(
 DEFAULT_DOCS = ('docs/benchmarks.md', 'docs/transport.md',
                 'docs/readahead.md', 'docs/tracing.md', 'docs/health.md',
                 'docs/lineage.md', 'docs/cache.md', 'docs/profiling.md',
-                'docs/decode.md', 'docs/latency.md')
+                'docs/decode.md', 'docs/latency.md', 'docs/autotune.md')
 MIN_ANNOTATIONS = 30
 
 #: Artifacts that MUST be quoted by at least one annotation across the
@@ -55,10 +55,12 @@ MIN_ANNOTATIONS = 30
 #: shared-cache decode-once record; round-12 adds BENCH_r12, the roofline
 #: calibration + attribution record; round-13 adds BENCH_r13, the
 #: batched-decode A/B + roofline record; round-14 adds BENCH_r14, the
-#: latency-plane overhead record).
+#: latency-plane overhead record; round-15 adds BENCH_r15, the autotune
+#: mis-tuned-recovery + steady-guard record).
 REQUIRED_ARTIFACTS = ('BENCH_r06.json', 'BENCH_r07.json', 'BENCH_r08.json',
                       'BENCH_r09.json', 'BENCH_r10.json', 'BENCH_r11.json',
-                      'BENCH_r12.json', 'BENCH_r13.json', 'BENCH_r14.json')
+                      'BENCH_r12.json', 'BENCH_r13.json', 'BENCH_r14.json',
+                      'BENCH_r15.json')
 
 def check_artifacts_intact(root: str = ROOT):
     """Reject any committed ``BENCH_*.json`` that carries a ``parsed`` key

@@ -229,6 +229,30 @@ def _overhead_entries(artifact, round_no, blob):
                    config, baseline, spread_pct=blob.get('spread_pct'))]
 
 
+def _autotune_entries(artifact, round_no, blob):
+    """Entries from the autotune benchmark (r15): the hand-tuned reference
+    rate and the controller-recovered rate (with roofline context) gate as
+    separate series; the mis-tuned start is context, not a series (it is a
+    deliberately broken config)."""
+    entries = []
+    base_config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+                   'rows': blob.get('rows')}
+    hand = blob.get('hand_tuned') or {}
+    sps = hand.get('samples_per_sec')
+    if isinstance(sps, (int, float)):
+        entries.append(_entry(artifact, round_no, 'autotune.hand_tuned',
+                              dict(base_config, **(hand.get('config') or {})),
+                              sps))
+    recovered = blob.get('recovered') or {}
+    sps = recovered.get('samples_per_sec')
+    if isinstance(sps, (int, float)):
+        roof = blob.get('roofline') or {}
+        entries.append(_entry(artifact, round_no, 'autotune.recovered',
+                              base_config, sps,
+                              roofline_pct=roof.get('roofline_pct')))
+    return entries
+
+
 def _shared_cache_entries(artifact, round_no, blob):
     """Entries from the shared-cache protocol record (r11): the measured
     serial roofline and the aggregate fleet rate."""
@@ -274,6 +298,8 @@ def normalize_artifact(name: str, blob: dict):
         entries.extend(_roofline_entries(name, round_no, payload))
     elif payload.get('benchmark', '').startswith('decode_batch'):
         entries.extend(_decode_batch_entries(name, round_no, payload))
+    elif payload.get('benchmark', '').startswith('autotune'):
+        entries.extend(_autotune_entries(name, round_no, payload))
     elif 'baseline_items_per_s' in payload:
         entries.extend(_overhead_entries(name, round_no, payload))
     elif 'shared' in payload and 'roofline' in payload:

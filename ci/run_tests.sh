@@ -65,6 +65,12 @@ PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_latency.py -q
 echo '== latency-overhead quick bench (streaming histograms + SLO monitor on vs off) =='
 python -m petastorm_tpu.benchmark.latency_overhead --quick
 
+echo '== autotune quick checks (controller policy, live pool resize, revert, kill switch; lockdep on) =='
+PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_autotune.py -q
+
+echo '== autotune quick bench (mis-tuned recovery + steady guard on the slow-io mnist line) =='
+python -m petastorm_tpu.benchmark.autotune --quick
+
 echo '== shared-cache quick checks (tiered segments, pins, concurrent attach; lockdep on) =='
 PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_sharedcache.py -q
 

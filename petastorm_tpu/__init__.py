@@ -17,6 +17,7 @@ __all__ = ['make_reader', 'make_batch_reader', 'make_columnar_reader',
            'make_jax_loader', 'make_dataset_converter', 'materialize_dataset',
            'CoverageAuditor', 'Provenance', 'SharedRowGroupCache',
            'LatencyHistogram', 'SLOMonitor',
+           'PipelineController',
            '__version__']
 
 
@@ -52,4 +53,7 @@ def __getattr__(name):
     if name in ('LatencyHistogram', 'SLOMonitor'):
         from petastorm_tpu import latency
         return getattr(latency, name)
+    if name == 'PipelineController':
+        from petastorm_tpu.autotune import PipelineController
+        return PipelineController
     raise AttributeError('module {!r} has no attribute {!r}'.format(__name__, name))

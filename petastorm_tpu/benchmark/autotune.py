@@ -1,0 +1,397 @@
+"""Autotune benchmark: a deliberately mis-tuned reader must recover, an
+already-tuned reader must not be degraded.
+
+The controller's value claim is closed-loop: tuning knowledge
+(docs/readahead.md's depth guidance, BENCH_r13's "more workers can be
+slower") should stop being something a user must discover by hand. Local CI
+disks are too fast to leave a mis-tuned reader anything to recover — with
+io essentially free, every knob is within noise of every other — so this
+bench runs the mnist-image line through the ``SlowFilesystem`` shim
+(BENCH_r07's remote-object-store protocol), with the per-read delay pinned
+so the storage ceiling ≈ the measured decode ceiling (io:decode ≈ 1:1, the
+regime where readahead is worth ~2x and ``io_readahead=0`` is a real
+mis-tuning). The protocol:
+
+1. **Pin the shim**: a no-delay counting pass measures reads-per-row-group;
+   a cold probe measures the decode ceiling; the per-read delay is derived
+   so one row group's synthetic I/O ≈ its decode time.
+2. **Calibrate cold** through the delayed shim (``profiler.calibrate``,
+   saved): the controller's first tick loads this cached artifact instead
+   of probing under load — probes during the measured window would both
+   perturb it and under-measure the ceilings.
+3. **Hand-tune by measurement**: a small grid WITHOUT the controller —
+   ``(w=1, ra=1)``, ``(w=1, ra=2)``, ``(w=default, ra=1)`` — best measured
+   rows/s is the hand-tuned reference (the grid, not an assumption,
+   decides; on a 1-core host w=1 wins, on a big host more workers may).
+4. **Recovery**: a mis-tuned reader (``workers=1, io_readahead=0``) streams
+   under the controller; the trailing-window rate is sampled each second.
+   Full gate: **>= 80% of the hand-tuned rate within 60s**, with the
+   action log, time-to-threshold and final config recorded.
+5. **Steady guard**: the hand-tuned config, controller OFF vs ON, in the
+   alternating-pair protocol (order flipped per pair, headline = median of
+   per-pair deltas — the r08/r14 drift-cancelling discipline). Full gate:
+   the controller costs **<= 5%** on a reader that is already right — its
+   hysteresis and quarantine must keep it quiet.
+
+The artifact carries roofline context (the recovered rate vs the calibrated
+binding ceiling), the controller's own prediction grading, and the model
+replay checks (including the BENCH_r13 negative-scaling direction check).
+``--quick`` shrinks the store and loosens the gates to a smoke.
+
+CLI (output is always JSON)::
+
+    python -m petastorm_tpu.benchmark.autotune [--quick] [--no-check]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import tempfile
+import time
+from collections import deque
+
+from petastorm_tpu.benchmark.readahead import SlowFilesystem
+
+#: Trailing window (seconds) the recovery loop rates over: long enough to
+#: smooth row-group granularity, short enough to watch convergence happen.
+TRAIL_S = 5.0
+
+
+def _make_reader(dataset_path, slow_fs, workers, io_readahead, num_epochs,
+                 autotune=False):
+    """A columnar reader over the shim filesystem (the readahead-bench
+    construction: ``Reader`` directly, so the filesystem factory can be the
+    wrapped instance)."""
+    from petastorm_tpu.cache import NullCache
+    from petastorm_tpu.reader import Reader
+    from petastorm_tpu.readers.columnar_worker import (ColumnarResultsReader,
+                                                       ColumnarWorker)
+    from petastorm_tpu.workers.thread_pool import ThreadPool
+    return Reader(lambda: slow_fs, dataset_path,
+                  worker_class=ColumnarWorker,
+                  results_reader_factory=ColumnarResultsReader,
+                  shuffle_row_groups=False, num_epochs=num_epochs,
+                  cache=NullCache(), pool=ThreadPool(workers, 50),
+                  is_batched_reader=True, io_readahead=io_readahead,
+                  autotune=autotune)
+
+
+def _measure_rate(dataset_path, slow_fs, workers, io_readahead,
+                  duration_s: float, warm_s: float = 1.0,
+                  autotune=False) -> dict:
+    """Stream continuously; rows/s over ``duration_s`` after a ``warm_s``
+    discard window."""
+    reader = _make_reader(dataset_path, slow_fs, workers, io_readahead,
+                          num_epochs=None, autotune=autotune)
+    rows = 0
+    marked = None
+    rate = 0.0
+    report = None
+    try:
+        start = time.perf_counter()
+        for batch in reader:
+            rows += len(batch.idx)
+            now = time.perf_counter()
+            if marked is None and now - start >= warm_s:
+                marked = (now, rows)
+            if marked is not None and now - marked[0] >= duration_s:
+                rate = (rows - marked[1]) / (now - marked[0])
+                break
+        if reader.autotune is not None:
+            report = reader.autotune.report()
+    finally:
+        reader.stop()
+        reader.join()
+    return {'samples_per_sec': round(rate, 1), 'autotune': report}
+
+
+def _recovery_run(dataset_path, slow_fs, target_rate: float,
+                  budget_s: float, scratch: str) -> dict:
+    """Stream a mis-tuned reader (w1, ra0) under the controller; sample the
+    trailing-window rate until it clears ``target_rate`` and settles, or
+    the budget runs out."""
+    reader = _make_reader(
+        dataset_path, slow_fs, workers=1, io_readahead=0, num_epochs=None,
+        autotune=dict(tick_interval_s=1.0, calibrate='auto',
+                      scratch_dir=scratch))
+    assert reader.autotune is not None
+    samples = []            # (elapsed_s, trailing_rate)
+    reached_at = None
+    try:
+        start = time.perf_counter()
+        window = deque()    # (ts, rows_cumulative)
+        rows = 0
+        last_sample = start
+        for batch in reader:
+            rows += len(batch.idx)
+            now = time.perf_counter()
+            window.append((now, rows))
+            while window and now - window[0][0] > TRAIL_S:
+                window.popleft()
+            elapsed = now - start
+            if now - last_sample >= 1.0 and len(window) >= 2:
+                last_sample = now
+                span = window[-1][0] - window[0][0]
+                trailing = ((window[-1][1] - window[0][1]) / span
+                            if span > 0 else 0.0)
+                samples.append((round(elapsed, 2), round(trailing, 1)))
+                if reached_at is None and trailing >= target_rate:
+                    reached_at = elapsed
+                # converged: threshold held long enough for the controller
+                # to grade its move — no need to burn the whole budget
+                if reached_at is not None and elapsed >= reached_at + 8.0:
+                    break
+            if elapsed >= budget_s:
+                break
+        span = (window[-1][0] - window[0][0]) if len(window) >= 2 else 0.0
+        final_rate = ((window[-1][1] - window[0][1]) / span
+                      if span > 0 else 0.0)
+        report = reader.autotune.report()
+    finally:
+        reader.stop()
+        reader.join()
+    return {
+        'samples_per_sec': round(final_rate, 1),
+        'seconds_to_threshold': (round(reached_at, 2)
+                                 if reached_at is not None else None),
+        'timeline': samples[-30:],
+        'final_config': report['config'],
+        'actions_total': report['actions_total'],
+        'reverts_total': report['reverts_total'],
+        'actions': [{k: a.get(k) for k in
+                     ('tick', 'knob', 'direction', 'from', 'applied',
+                      'policy', 'predicted_gain_pct', 'measured_delta_pct',
+                      'prediction_error_pct', 'graded')}
+                    for a in report['actions']],
+        'prediction': report['prediction'],
+    }
+
+
+def run_autotune_bench(quick: bool = False, check: bool = True) -> dict:
+    import fsspec
+
+    from petastorm_tpu import profiler
+    from petastorm_tpu.autotune import AUTOTUNE_DIR_ENV_VAR
+    from petastorm_tpu.benchmark.northstar import (
+        _default_workers, generate_mnist_images_dataset)
+    from petastorm_tpu.etl.dataset_metadata import (infer_or_load_unischema,
+                                                    load_row_groups)
+
+    rows = 512 if quick else 2048
+    pass_s = 2.0 if quick else 4.0
+    budget_s = 30.0 if quick else 60.0
+    pairs = 2 if quick else 3
+    tmpdir = tempfile.mkdtemp(prefix='petastorm_tpu_autotune_bench_')
+    dataset_path = os.path.join(tmpdir, 'ds')
+    scratch = os.path.join(tmpdir, 'arbitration')
+    saved_cal = os.environ.get(profiler.CALIBRATION_DIR_ENV_VAR)
+    saved_arb = os.environ.get(AUTOTUNE_DIR_ENV_VAR)
+    os.environ[profiler.CALIBRATION_DIR_ENV_VAR] = os.path.join(tmpdir, 'cal')
+    os.environ[AUTOTUNE_DIR_ENV_VAR] = scratch
+    try:
+        # small row groups: the row group is the readahead/ventilation unit,
+        # and the knobs need granularity to show up in a trailing window
+        generate_mnist_images_dataset('file://' + dataset_path, rows=rows,
+                                      row_group_size_mb=0.05)
+        base_fs = fsspec.filesystem('file')
+        cpu = os.cpu_count() or 1
+
+        # 1. pin the shim: reads per row group (counting pass) + decode
+        # ceiling (cold probe) -> per-read delay for io:decode = 1:1
+        counting_fs = SlowFilesystem(base_fs)
+        groups = 0
+        reader = _make_reader(dataset_path, counting_fs, 1, 0, num_epochs=1)
+        try:
+            for _ in reader:
+                groups += 1
+        finally:
+            reader.stop()
+            reader.join()
+        reads_per_group = max(1.0, counting_fs.read_calls / groups)
+        rows_per_group = rows / groups
+        schema, _ = infer_or_load_unischema(base_fs, dataset_path)
+        pieces = load_row_groups(base_fs, dataset_path)
+        cold = profiler.calibrate(base_fs, dataset_path, pieces, schema,
+                                  save=False)
+        decode_ceiling = (cold.get('ceilings') or {}).get('decode') or 1.0
+        io_s_per_group = rows_per_group / decode_ceiling
+        delay_per_read = io_s_per_group / reads_per_group
+
+        def make_slow_fs():
+            return SlowFilesystem(base_fs, seconds_per_read=delay_per_read)
+
+        # 2. calibrate COLD through the delayed shim and cache the artifact:
+        # the controller's first tick loads it instead of probing under load
+        calibration = profiler.calibrate(make_slow_fs(), dataset_path,
+                                         pieces, schema, save=True)
+
+        # 3. hand-tune by measurement
+        grid_configs = {'w1_ra1': (1, 1), 'w1_ra2': (1, 2)}
+        default_workers = _default_workers()
+        if default_workers > 1:
+            grid_configs['w{}_ra1'.format(default_workers)] = (
+                default_workers, 1)
+        grid = {name: _measure_rate(dataset_path, make_slow_fs(), w, ra,
+                                    pass_s)['samples_per_sec']
+                for name, (w, ra) in grid_configs.items()}
+        hand_key = max(grid, key=grid.get)
+        hand_tuned = grid[hand_key]
+        hand_workers, hand_ra = grid_configs[hand_key]
+
+        # mis-tuned start rate (no controller) for the artifact's "before"
+        mistuned = _measure_rate(dataset_path, make_slow_fs(), 1, 0,
+                                 pass_s)['samples_per_sec']
+
+        # 4. recovery under the controller
+        recovery = _recovery_run(dataset_path, make_slow_fs(),
+                                 0.8 * hand_tuned, budget_s, scratch)
+        recovery['recovery_fraction'] = round(
+            recovery['samples_per_sec'] / hand_tuned, 4) if hand_tuned else 0
+
+        # 5. steady guard: hand-tuned config, controller off vs on, paired
+        deltas, pair_records = [], []
+        for pair in range(pairs):
+            order = (False, True) if pair % 2 == 0 else (True, False)
+            rates = {}
+            for tuned in order:
+                options = (dict(tick_interval_s=1.0, calibrate='auto',
+                                scratch_dir=scratch) if tuned else False)
+                rates[tuned] = _measure_rate(
+                    dataset_path, make_slow_fs(), hand_workers, hand_ra,
+                    pass_s, autotune=options)['samples_per_sec']
+            baseline, tuned_rate = rates[False], rates[True]
+            delta = (100.0 * (baseline - tuned_rate) / baseline
+                     if baseline else 0.0)
+            deltas.append(delta)
+            pair_records.append({'baseline': baseline,
+                                 'autotuned': tuned_rate,
+                                 'delta_pct': round(delta, 2)})
+        deltas.sort()
+        steady_delta = deltas[len(deltas) // 2]
+
+        ceilings = calibration.get('ceilings') or {}
+        binding = min((s for s in ('io', 'decode') if ceilings.get(s)),
+                      key=lambda s: ceilings[s], default=None)
+        binding_ceiling = ceilings.get(binding) if binding else None
+        roofline_fraction = (
+            round(recovery['samples_per_sec'] / binding_ceiling, 4)
+            if binding_ceiling else None)
+        result = {
+            'quick': quick,
+            'benchmark': 'autotune_mnist_slow_io',
+            'rows': rows,
+            'cpu_count': cpu,
+            'protocol': {
+                'pass_duration_s': pass_s,
+                'recovery_budget_s': budget_s,
+                'trailing_window_s': TRAIL_S,
+                'steady_pairs': pairs,
+                'tick_interval_s': 1.0,
+                'pool': 'thread',
+                'rows_per_group': round(rows_per_group, 1),
+                'delay_per_read_s': round(delay_per_read, 6),
+                'reads_per_group': round(reads_per_group, 1),
+            },
+            'ceilings_samples_per_sec': {
+                k: v for k, v in ceilings.items() if v},
+            'hand_tuned': {
+                'config': {'workers': hand_workers,
+                           'io_readahead': hand_ra},
+                'samples_per_sec': hand_tuned,
+                'grid': grid,
+            },
+            'mistuned': {
+                'config': {'workers': 1, 'io_readahead': 0},
+                'samples_per_sec': mistuned,
+            },
+            'recovered': recovery,
+            'steady': {
+                'config': {'workers': hand_workers,
+                           'io_readahead': hand_ra},
+                'median_delta_pct': round(steady_delta, 2),
+                'pairs': pair_records,
+            },
+            'roofline': {
+                'binding_stage': binding,
+                'binding_ceiling_samples_per_s': binding_ceiling,
+                'roofline_fraction': roofline_fraction,
+                'roofline_pct': (round(100.0 * roofline_fraction, 2)
+                                 if roofline_fraction is not None else None),
+            },
+            'model_checks': profiler.replay_against_artifacts(),
+        }
+        if check:
+            _check(result, quick)
+        return result
+    finally:
+        if saved_cal is None:
+            os.environ.pop(profiler.CALIBRATION_DIR_ENV_VAR, None)
+        else:
+            os.environ[profiler.CALIBRATION_DIR_ENV_VAR] = saved_cal
+        if saved_arb is None:
+            os.environ.pop(AUTOTUNE_DIR_ENV_VAR, None)
+        else:
+            os.environ[AUTOTUNE_DIR_ENV_VAR] = saved_arb
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _check(result: dict, quick: bool) -> None:
+    recovered = result['recovered']
+    assert recovered['actions_total'] >= 1, (
+        'the controller took no action on a reader mis-tuned by '
+        'construction')
+    if quick:
+        # Quick mode runs sub-second windows on a possibly loaded CI host,
+        # where ABSOLUTE rates drift far more than the effect size across
+        # passes minutes apart. The robust smoke signal is the controller's
+        # OWN grading — pre/post windows measured back to back around its
+        # move — which must show the move helped.
+        graded = [a for a in recovered['actions']
+                  if a.get('measured_delta_pct') is not None]
+        assert graded and max(a['measured_delta_pct'] for a in graded) > 0, (
+            'no controller move measured a positive delta — actions: '
+            '{}'.format(recovered['actions']))
+    else:
+        recovery = recovered['recovery_fraction']
+        assert recovery >= 0.8, (
+            'mis-tuned reader recovered to only {:.0%} of the hand-tuned '
+            'rate (gate: >= 80% within the budget) — controller actions: '
+            '{}'.format(recovery, recovered['actions']))
+        assert recovered['seconds_to_threshold'] is not None, (
+            'the 80% threshold was never reached inside the {}s budget'
+            .format(result['protocol']['recovery_budget_s']))
+    steady = result['steady']['median_delta_pct']
+    bar = 15.0 if quick else 5.0
+    assert steady <= bar, (
+        'the controller degraded an already-tuned reader by {:.1f}% '
+        '(gate: <= {:.0f}% median-of-pairs)'.format(steady, bar))
+    failed = [c for c in result['model_checks'] if not c['ok']]
+    assert not failed, 'model replay checks failed: {}'.format(failed)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description='autotune controller benchmark: mis-tuned recovery + '
+                    'already-tuned non-degradation on the slow-io mnist '
+                    'line')
+    parser.add_argument('--quick', action='store_true',
+                        help='small store, loose smoke gates (CI lane)')
+    parser.add_argument('--no-check', action='store_true',
+                        help='measure and print without asserting gates')
+    parser.add_argument('--out', metavar='PATH', default=None,
+                        help='also write the JSON result to PATH')
+    args = parser.parse_args(argv)
+    result = run_autotune_bench(quick=args.quick, check=not args.no_check)
+    blob = json.dumps(result, indent=2, sort_keys=True)
+    print(blob)
+    if args.out:
+        from petastorm_tpu.utils import atomic_write
+        atomic_write(args.out, lambda f: f.write(blob + '\n'))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -104,6 +104,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument('--slo-min-samples-per-s', type=float, default=None,
                         help='add a minimum samples/s target to the SLO '
                              'monitor (window rate from ReaderStats)')
+    parser.add_argument('--autotune', action='store_true',
+                        help='run the model-predictive pipeline controller '
+                             'on the benchmarked reader: live worker/'
+                             'readahead/window/queue tuning with hysteresis '
+                             'and revert-on-regression; the controller '
+                             'report (every move, predicted vs measured) '
+                             'prints after the run (see docs/autotune.md)')
     parser.add_argument('--on-decode-error', default='raise',
                         choices=['raise', 'skip', 'quarantine'],
                         help="bad-sample policy: 'raise' propagates decode/"
@@ -142,7 +149,7 @@ def main(argv=None) -> int:
         metrics_interval=args.metrics_interval,
         metrics_out=args.metrics_out, debug_port=args.debug_port,
         stall_timeout=args.stall_timeout, audit=args.audit,
-        profile=args.profile, slo=slo or None,
+        profile=args.profile, slo=slo or None, autotune=args.autotune,
         on_decode_error=args.on_decode_error, cache_type=args.cache_type,
         cache_location=args.cache_location,
         cache_size_limit=args.cache_size_limit)
@@ -184,6 +191,12 @@ def main(argv=None) -> int:
         import json
         print('SLO verdict (median run): {}'.format(
             json.dumps(result.slo, sort_keys=True, default=str)))
+    if args.autotune and result.autotune is not None:
+        import json
+        report = dict(result.autotune)
+        report['actions'] = report.get('actions', [])[-10:]
+        print('Autotune report (median run): {}'.format(
+            json.dumps(report, sort_keys=True, default=str)))
     if args.audit and result.audit is not None:
         import json
         print('Coverage audit (median run): {}'.format(

@@ -48,6 +48,12 @@ class ThroughputResult:
     #: error-budget burn — what the CLI's ``--slo-p99-ms`` prints. ``None``
     #: when no targets were set (see ``docs/latency.md``).
     slo: Optional[dict] = None
+    #: The autotune controller's self-grading report
+    #: (``reader.autotune.report()``) when the run was launched with
+    #: ``autotune=True`` — every knob move with its predicted-vs-measured
+    #: delta, what the CLI's ``--autotune`` prints. ``None`` when the
+    #: controller was off (see ``docs/autotune.md``).
+    autotune: Optional[dict] = None
 
 
 def _consume(iterator, count: int, batched: bool) -> int:
@@ -84,6 +90,7 @@ def reader_throughput(dataset_url: str,
                       audit: bool = False,
                       profile: bool = False,
                       slo: Optional[dict] = None,
+                      autotune=False,
                       on_decode_error: str = 'raise',
                       cache_type: str = 'null',
                       cache_location: Optional[str] = None,
@@ -112,7 +119,8 @@ def reader_throughput(dataset_url: str,
                   debug_port=debug_port, stall_timeout=stall_timeout,
                   on_decode_error=on_decode_error, cache_type=cache_type,
                   cache_location=cache_location,
-                  cache_size_limit=cache_size_limit, slo=slo)
+                  cache_size_limit=cache_size_limit, slo=slo,
+                  autotune=autotune)
     if field_regex is not None:
         kwargs['schema_fields'] = field_regex
 
@@ -165,6 +173,10 @@ def reader_throughput(dataset_url: str,
             lineage = getattr(reader, 'lineage', None)
             audit_report = (lineage.coverage_report()
                             if lineage is not None else {'enabled': False})
+        autotune_report = None
+        controller = getattr(reader, 'autotune', None)
+        if controller is not None:
+            autotune_report = controller.report()
         profile_report = None
         if profile:
             # the measured window's own samples/s is the honest numerator
@@ -186,4 +198,5 @@ def reader_throughput(dataset_url: str,
                             diagnosis=diagnosis,
                             audit=audit_report,
                             profile=profile_report,
-                            slo=slo_verdict)
+                            slo=slo_verdict,
+                            autotune=autotune_report)

@@ -348,7 +348,8 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
                         lineage: Optional[dict] = None,
                         roofline: Optional[dict] = None,
                         latency: Optional[dict] = None,
-                        slo: Optional[dict] = None) -> dict:
+                        slo: Optional[dict] = None,
+                        autotune: Optional[dict] = None) -> dict:
     """Assemble the flight-recorder artifact: everything needed to diagnose
     a stall *after* the process is gone. JSON-able by construction.
     ``lineage`` (a tracker's ``flight_summary()``) adds the coverage audit
@@ -361,7 +362,11 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
     ``PipelineLatency.flight_summary()``) embeds per-stage percentiles plus
     the recent per-interval p99 trend — whether the episode was a cliff or
     a creep; ``slo`` (an ``SLOMonitor.evaluate()`` verdict) records the
-    burn state at the moment of death (see ``docs/latency.md``)."""
+    burn state at the moment of death (see ``docs/latency.md``);
+    ``autotune`` (a ``PipelineController.flight_summary()``) records the
+    controller's recent knob moves and prediction grades — a stall that
+    follows a controller action must be attributable to it
+    (``docs/autotune.md``)."""
     record = {
         'kind': 'petastorm_tpu_flight_record',
         # deliberate wall clock: a human-facing artifact timestamp, never
@@ -385,6 +390,8 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
         record['latency'] = latency
     if slo is not None:
         record['slo'] = slo
+    if autotune is not None:
+        record['autotune'] = autotune
     return record
 
 
@@ -539,6 +546,12 @@ class DebugServer:
       the calibrated per-stage ceilings, binding stage, overlap-aware
       attribution, advisor recommendations. 404 when the profiler is
       disabled (``PETASTORM_TPU_PROFILER=0``) or not wired.
+    - ``GET /autotune`` — the autotune controller's self-grading report
+      (:meth:`petastorm_tpu.autotune.PipelineController.report`): every
+      ringed action with its sensor evidence and predicted-vs-measured
+      delta, the aggregate model error, quarantines, and the current knob
+      state. 404 when the reader runs without a controller (autotune off or
+      kill-switched).
     - ``GET /stacks`` — plain-text stack dump of every in-process thread.
 
     Requests are served on daemon threads (``ThreadingHTTPServer``);
@@ -552,13 +565,15 @@ class DebugServer:
                  port: int = 0, prefix: str = 'petastorm_tpu',
                  coverage_fn: Optional[Callable[[], dict]] = None,
                  profile_fn: Optional[Callable[[], dict]] = None,
-                 slo_fn: Optional[Callable[[], dict]] = None):
+                 slo_fn: Optional[Callable[[], dict]] = None,
+                 autotune_fn: Optional[Callable[[], dict]] = None):
         self._evaluate_fn = evaluate_fn
         self._snapshot_fn = snapshot_fn or (lambda: {})
         self._heartbeats_fn = heartbeats_fn or (lambda: {})
         self._coverage_fn = coverage_fn
         self._profile_fn = profile_fn
         self._slo_fn = slo_fn
+        self._autotune_fn = autotune_fn
         self._requested_port = port
         self._prefix = prefix
         self._server = None
@@ -646,6 +661,17 @@ class DebugServer:
                             self._reply(200, 'application/json',
                                         json.dumps(outer._profile_fn(),
                                                    default=str))
+                    elif route == '/autotune':
+                        if outer._autotune_fn is None:
+                            self._reply(404, 'text/plain',
+                                        'no autotune controller runs for '
+                                        'this reader (pass autotune=True to '
+                                        'the factory, or set '
+                                        'PETASTORM_TPU_AUTOTUNE=1)\n')
+                        else:
+                            self._reply(200, 'application/json',
+                                        json.dumps(outer._autotune_fn(),
+                                                   default=str))
                     elif route == '/stacks':
                         stacks = thread_stacks()
                         body = '\n'.join('== {} ==\n{}'.format(name, stack)
@@ -656,7 +682,7 @@ class DebugServer:
                         self._reply(404, 'text/plain',
                                     'unknown route {}; try /healthz /metrics '
                                     '/diagnostics /coverage /profile /slo '
-                                    '/stacks\n'.format(route))
+                                    '/autotune /stacks\n'.format(route))
                 except Exception as e:  # report, never kill the serve loop
                     logger.exception('debug endpoint request failed')
                     try:

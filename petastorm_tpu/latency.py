@@ -423,6 +423,17 @@ class PipelineLatency:
         histogram = self.histograms.get(stage)
         return histogram.quantile(q, window=window) if histogram else None
 
+    def window_p99s(self) -> Dict[str, float]:
+        """Rolling-window p99 per stage, stages with window data only — the
+        compact sensor view the autotune controller reads each tick (and a
+        cheap answer to "what does the tail look like right now")."""
+        out = {}
+        for stage, histogram in self.histograms.items():
+            p99 = histogram.quantile(0.99, window=True)
+            if p99 is not None:
+                out[stage] = p99
+        return out
+
     def export_state(self) -> Dict[str, dict]:
         """``{stage: state}`` for stages with at least one observation —
         what rides under ``'_latency_histograms'`` in stats snapshots (and

@@ -630,14 +630,23 @@ def predict_throughput(ceilings: dict, workers: int = 1,
                        cpu_count: Optional[int] = None,
                        io_overlap: bool = False,
                        in_process: bool = True,
-                       cached: bool = False) -> Optional[float]:
+                       cached: bool = False,
+                       worker_efficiency: float = 1.0) -> Optional[float]:
     """Predicted samples/s from calibrated single-stream ceilings.
 
     The model (docs/profiling.md "Attribution math"):
 
     - decode scales with effective parallel workers ``min(workers,
       cpu_count)`` (per BENCH_scaling.json: workers beyond cores
-      time-slice, they do not add decode);
+      time-slice, they do not add decode), damped by ``worker_efficiency``
+      — the *measured* marginal value of each extra worker. ``1.0`` is
+      ideal scaling (the default and the old behavior); ``0.0`` means
+      extra workers add nothing; **negative** values model the GIL-convoy
+      regime BENCH_r13 measured (2 thread workers 2.6x *slower* than 1 on
+      ~10µs decode calls: sub-GIL-quantum work makes workers serialize on
+      the lock instead of the codecs). Effective parallelism is
+      ``1 + worker_efficiency * (eff_workers - 1)``, floored at 0.05 so a
+      pathological factor predicts "much slower", never zero;
     - storage is a shared resource (no worker scaling);
     - without readahead a worker serializes read→decode, so the combined
       rate is harmonic (``1/(1/io + 1/decode)``); with ``io_overlap``
@@ -647,16 +656,20 @@ def predict_throughput(ceilings: dict, workers: int = 1,
     - device staging caps everything (it is downstream of any cache);
     - ``cached`` (warm shared/local tier) skips io+decode entirely.
 
-    Monotone in ``workers`` by construction — every term is nondecreasing
-    in the effective worker count (the advisor's monotonicity contract,
-    asserted in tests).
+    Monotone in ``workers`` by construction **for non-negative
+    worker_efficiency** — every term is then nondecreasing in the
+    effective worker count (the advisor's monotonicity contract, asserted
+    in tests). A negative measured factor deliberately breaks monotonicity:
+    that is the point (the model must be able to predict that removing a
+    worker is the winning move).
     """
     io = ceilings.get('io')
     decode = ceilings.get('decode')
     caps = []
     if not cached:
         eff = max(1, min(workers, cpu_count or workers))
-        scaled_decode = decode * eff if decode else None
+        parallel = max(0.05, 1.0 + worker_efficiency * (eff - 1))
+        scaled_decode = decode * parallel if decode else None
         if io and scaled_decode:
             if io_overlap:
                 caps.append(min(io, scaled_decode))
@@ -681,6 +694,28 @@ def predict_throughput(ceilings: dict, workers: int = 1,
     if not caps:
         return None
     return min(caps)
+
+
+def measured_worker_efficiency(measured_samples_per_s,
+                               decode_ceiling,
+                               workers: int) -> Optional[float]:
+    """The per-worker efficiency factor implied by a *measured* rate on a
+    decode-bound pipeline: solve ``measured = ceiling * (1 + e*(w-1))`` for
+    ``e``, clamped to ``[-1, 1]``. ``None`` when underdetermined (one
+    worker, or no decode ceiling) — with one worker the marginal value of a
+    second is unknowable until tried, which is exactly why the autotune
+    controller pairs this model with revert-on-regression.
+
+    This is how BENCH_r13's GIL-convoy evidence (w2 at 25% of the decode
+    ceiling vs w1 at 66%) becomes representable: the implied ``e`` is
+    strongly negative, and the model then predicts the *removal* of a
+    worker as a gain (see :func:`replay_against_artifacts`)."""
+    if workers is None or workers <= 1:
+        return None
+    if not decode_ceiling or not measured_samples_per_s:
+        return None
+    e = (measured_samples_per_s / decode_ceiling - 1.0) / (workers - 1)
+    return max(-1.0, min(1.0, e))
 
 
 def build_profile(snapshot: dict, calibration: Optional[dict] = None,
@@ -1005,4 +1040,45 @@ def replay_against_artifacts(root: Optional[str] = None) -> List[dict]:
                        'detail': 'model cached {:.0f} >= uncached {:.0f}; '
                                  'measured warm {} vs roofline {}'.format(
                                      cached, uncached, warm, roof)})
+    # BENCH_r13: 2 thread workers measured ~2.6x SLOWER than 1 on the
+    # small-png mnist line (GIL convoy on ~10µs decode calls). With the
+    # measured per-worker efficiency factor the model must predict the w2
+    # direction DOWN — the honest-measurement note the default ideal-scaling
+    # model could not represent (and the regression the autotune
+    # controller's revert path exists to undo when it walks into it blind).
+    r13 = load('BENCH_r13.json')
+    if r13 is not None:
+        lines = r13.get('lines') or {}
+        w1 = (lines.get('mnist_w1_batched') or {}).get('samples_per_sec')
+        w2_line = lines.get('mnist_w2_batched') or {}
+        w2 = w2_line.get('samples_per_sec')
+        decode_ceiling = ((w2_line.get('roofline') or {})
+                          .get('ceilings') or {}).get('decode')
+        if w1 and w2 and decode_ceiling:
+            efficiency = measured_worker_efficiency(w2, decode_ceiling, 2)
+            ceilings = {'io': 10.0 * decode_ceiling,
+                        'decode': decode_ceiling}
+            base = predict_throughput(ceilings, workers=1, cpu_count=2,
+                                      io_overlap=True)
+            measured_model = predict_throughput(
+                ceilings, workers=2, cpu_count=2, io_overlap=True,
+                worker_efficiency=efficiency)
+            ideal_model = predict_throughput(ceilings, workers=2,
+                                             cpu_count=2, io_overlap=True)
+            # the measured factor must flip the predicted direction to
+            # match the measurement (down), while the ideal factor still
+            # predicts up — proving the knob adds representational power
+            # rather than just re-deriving the ideal curve
+            ok = (w2 < w1 and measured_model < base
+                  and ideal_model > base and efficiency is not None
+                  and efficiency < 0)
+            checks.append({
+                'check': 'gil_convoy_negative_scaling_direction',
+                'artifact': 'BENCH_r13.json', 'ok': ok,
+                'detail': 'measured w1 {:.0f} -> w2 {:.0f}; implied '
+                          'efficiency {:.2f}; model w2 {:.0f} vs w1 {:.0f} '
+                          '(ideal-scaling model said {:.0f})'.format(
+                              w1, w2, efficiency or 0.0,
+                              measured_model or 0.0, base or 0.0,
+                              ideal_model or 0.0)})
     return checks

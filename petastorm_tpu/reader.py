@@ -183,7 +183,7 @@ def make_reader(dataset_url,
                 io_readahead=0, trace=None, metrics_interval=0,
                 metrics_out=None, debug_port=None, stall_timeout=0,
                 flight_record_dir=None, on_decode_error='raise',
-                slo=None):
+                slo=None, autotune=False):
     """Row-granular reader for petastorm_tpu datasets (codec-decoded rows).
 
     Mirrors the reference factory (``reader.py:61-195``). Raises a helpful error
@@ -232,6 +232,15 @@ def make_reader(dataset_url,
     propagates decode/transform exceptions, ``'skip'`` drops the failing
     rows counting them, ``'quarantine'`` drops them AND records
     provenance-tagged quarantine records. See ``docs/lineage.md``.
+
+    ``autotune=True`` (or an options dict; job-wide via
+    ``PETASTORM_TPU_AUTOTUNE=1``, kill switch ``=0``) starts the
+    model-predictive pipeline controller: a background thread that
+    live-resizes the worker pool, readahead depth, ventilation window and
+    results-queue bound toward the roofline model's best predicted
+    configuration, with hysteresis, per-knob cooldowns and
+    revert-on-regression. Every action is observable via ``/autotune``,
+    flight records and ``/metrics``. See ``docs/autotune.md``.
     """
     dataset_url = normalize_dataset_url_or_urls(dataset_url)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url, storage_options)
@@ -268,7 +277,8 @@ def make_reader(dataset_url,
                   metrics_interval=metrics_interval, metrics_out=metrics_out,
                   debug_port=debug_port, stall_timeout=stall_timeout,
                   flight_record_dir=flight_record_dir,
-                  on_decode_error=on_decode_error, slo=slo)
+                  on_decode_error=on_decode_error, slo=slo,
+                  autotune=autotune)
 
 
 def make_columnar_reader(dataset_url,
@@ -288,7 +298,7 @@ def make_columnar_reader(dataset_url,
                          io_readahead=0, trace=None, metrics_interval=0,
                          metrics_out=None, debug_port=None, stall_timeout=0,
                          flight_record_dir=None, on_decode_error='raise',
-                         slo=None):
+                         slo=None, autotune=False):
     """Vectorized codec-decoded reader for petastorm_tpu datasets.
 
     Yields **batch namedtuples of decoded numpy column arrays** (one per row
@@ -340,7 +350,8 @@ def make_columnar_reader(dataset_url,
                   metrics_interval=metrics_interval, metrics_out=metrics_out,
                   debug_port=debug_port, stall_timeout=stall_timeout,
                   flight_record_dir=flight_record_dir,
-                  on_decode_error=on_decode_error, slo=slo)
+                  on_decode_error=on_decode_error, slo=slo,
+                  autotune=autotune)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -357,7 +368,7 @@ def make_batch_reader(dataset_url_or_urls,
                       profiling_enabled=False, io_readahead=0, trace=None,
                       metrics_interval=0, metrics_out=None, debug_port=None,
                       stall_timeout=0, flight_record_dir=None,
-                      on_decode_error='raise', slo=None):
+                      on_decode_error='raise', slo=None, autotune=False):
     """Vectorized batch reader for arbitrary parquet stores
     (reference ``reader.py:198-327``). Yields namedtuples of column arrays,
     one per row group. ``io_readahead`` prefetches upcoming row-group reads
@@ -393,7 +404,8 @@ def make_batch_reader(dataset_url_or_urls,
                   metrics_out=metrics_out, debug_port=debug_port,
                   stall_timeout=stall_timeout,
                   flight_record_dir=flight_record_dir,
-                  on_decode_error=on_decode_error, slo=slo)
+                  on_decode_error=on_decode_error, slo=slo,
+                  autotune=autotune)
 
 
 class Reader:
@@ -409,7 +421,7 @@ class Reader:
                  io_readahead=0, trace_export=None, metrics_interval=0,
                  metrics_out=None, debug_port=None, stall_timeout=0,
                  flight_record_dir=None, on_decode_error='raise',
-                 slo=None):
+                 slo=None, autotune=False):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -430,6 +442,15 @@ class Reader:
             # built after the pool (it reads the stats snapshot + latency)
             from petastorm_tpu.latency import validate_slo_targets
             slo = validate_slo_targets(slo)
+        # resolve autotune BEFORE any pipeline state exists: a typo'd option
+        # must fail the factory, and the PETASTORM_TPU_AUTOTUNE=0 kill
+        # switch must yield a reader with no controller thread and no files
+        from petastorm_tpu.autotune import resolve_autotune
+        autotune_options = resolve_autotune(autotune)
+        #: The reader's :class:`~petastorm_tpu.autotune.PipelineController`
+        #: (``None`` unless autotune resolved on): serves ``/autotune`` and
+        #: owns the live worker/readahead/window/queue knobs.
+        self._controller = None
         #: The reader's :class:`~petastorm_tpu.latency.SLOMonitor`
         #: (``None`` unless built with ``slo=dict(...)``); serves ``/slo``
         #: and feeds the burn accounting from the watchdog tick.
@@ -622,11 +643,21 @@ class Reader:
             heartbeat=self.health.beat if self.health.enabled else None,
             epoch_key='epoch')
 
+        # the controller owns the readahead knob when autotune is on: the
+        # machinery is constructed (dormant at depth 0) even when the reader
+        # starts with readahead off, and 'auto' stops self-tuning locally —
+        # two controllers on one knob would oscillate (docs/autotune.md)
+        autotune_active = (autotune_options is not None
+                           and self._pool_type in ('thread', 'process'))
+        if autotune_options is not None and not autotune_active:
+            logger.warning('autotune disabled: the %s pool has no live '
+                           'actuators', self._pool_type)
         worker_args = {
             'trace': tracer is not None,
             'health': self.health.enabled,
             'lineage': self.lineage.enabled,
             'latency': getattr(pool.stats, 'latency', None) is not None,
+            'readahead_controlled': autotune_active,
             'on_decode_error': on_decode_error,
             'shard': cur_shard if cur_shard is not None else -1,
             'filesystem_factory': filesystem_factory,
@@ -659,6 +690,44 @@ class Reader:
             self._slo = SLOMonitor(slo, snapshot_fn=self._stats_snapshot,
                                    latency=getattr(pool.stats, 'latency',
                                                    None))
+        # -- autotune controller (see docs/autotune.md) ------------------------
+        if autotune_active:
+            from petastorm_tpu import profiler as _profiler
+            from petastorm_tpu.autotune import (HostArbiter,
+                                                PipelineController,
+                                                ReaderActuators, scratch_dir)
+            from petastorm_tpu.readers.readahead import AUTO_INITIAL_DEPTH
+            initial_depth = (AUTO_INITIAL_DEPTH if io_readahead == 'auto'
+                             else int(io_readahead or 0))
+            calibrate_mode = autotune_options['calibrate']
+            calibration_schema = view_schema
+
+            def calibration_fn():
+                # probes (if any) run on the controller thread, never the
+                # hot path; 'cached' never probes at all
+                if not _profiler.profiler_enabled():
+                    return None
+                return _profiler.get_calibration(
+                    self._filesystem_factory(), self._dataset_path,
+                    self._pieces, calibration_schema, mode=calibrate_mode)
+
+            self._controller = PipelineController(
+                ReaderActuators(
+                    pool, ventilator=self._ventilator,
+                    pool_type=self._pool_type,
+                    resize_timeout_s=float(
+                        autotune_options['resize_timeout_s']),
+                    initial_readahead=initial_depth),
+                self._stats_snapshot,
+                calibration_fn=calibration_fn,
+                latency=getattr(pool.stats, 'latency', None),
+                slo_targets=slo or {},
+                options=autotune_options,
+                arbiter=HostArbiter(
+                    scratch_dir(autotune_options),
+                    cpu_count=os.cpu_count() or 1,
+                    tick_interval_s=autotune_options['tick_interval_s']))
+            self._controller.start()
         pool_heartbeats = getattr(pool, 'heartbeats', None)
         if pool_heartbeats is not None:
             self.health.add_source(pool_heartbeats)
@@ -684,7 +753,9 @@ class Reader:
                 profile_fn=(self._profile_route if profiler_enabled()
                             else None),
                 slo_fn=(self._slo.evaluate if self._slo is not None
-                        else None))
+                        else None),
+                autotune_fn=(self._controller.report
+                             if self._controller is not None else None))
             try:
                 self._debug_server.start()
             except (OSError, OverflowError) as e:   # taken / out-of-range port
@@ -930,7 +1001,11 @@ class Reader:
                                      latency=(latency_plane.flight_summary()
                                               if latency_plane is not None
                                               else None),
-                                     slo=slo_verdict)
+                                     slo=slo_verdict,
+                                     autotune=(
+                                         self._controller.flight_summary()
+                                         if self._controller is not None
+                                         else None))
         if path is None:
             import tempfile
             out_dir = self._flight_record_dir or tempfile.gettempdir()
@@ -949,6 +1024,8 @@ class Reader:
         snapshot = self._pool.stats.snapshot()
         if self._roofline_gauges:
             snapshot.update(self._roofline_gauges)
+        if self._controller is not None:
+            snapshot.update(self._controller.gauges())
         return snapshot
 
     def profile(self, calibrate='auto', sample_row_groups: int = 3,
@@ -1081,6 +1158,10 @@ class Reader:
         uncleanly: an unclean pool must never leave monitoring threads
         running against a corpse."""
         self._stopped = True
+        if self._controller is not None:
+            # signal the controller before the pool goes down: a tick that
+            # lands mid-teardown must find the stop event, not a corpse
+            self._controller.stop(join=False)
         if self._metrics_emitter is not None:
             self._metrics_emitter.stop(join=False)
         if self._watchdog is not None:
@@ -1096,6 +1177,10 @@ class Reader:
         watchdog and debug server (all with bounded joins). Idempotent —
         every stop below tolerates being called again — so teardown paths
         that cannot know whether an earlier join ran may call it anyway."""
+        # the controller joins FIRST: a tick actuating mid-join would race
+        # the pool's socket teardown below
+        if self._controller is not None:
+            self._controller.stop()
         try:
             self._pool.join()
         finally:
@@ -1137,6 +1222,15 @@ class Reader:
         unless built with ``slo=dict(...)``). ``reader.slo.evaluate()`` is
         the on-demand verdict the ``/slo`` route serves."""
         return self._slo
+
+    @property
+    def autotune(self):
+        """The reader's
+        :class:`~petastorm_tpu.autotune.PipelineController` (``None``
+        unless autotune resolved on — ``autotune=`` kwarg or
+        ``PETASTORM_TPU_AUTOTUNE=1``, minus the kill switch).
+        ``reader.autotune.report()`` is what ``/autotune`` serves."""
+        return self._controller
 
     @property
     def latency(self):

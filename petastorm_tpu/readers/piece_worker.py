@@ -177,7 +177,11 @@ class ParquetPieceWorker(WorkerBase):
         self._readahead = None
         self._prefetch_files: Optional[FileHandleCache] = None
         depth = args.get('io_readahead') or 0
-        if depth:
+        # controller-owned depth (docs/autotune.md): the machinery must
+        # exist even at depth 0 so the autotune controller can raise the
+        # knob live on a reader that started with readahead off
+        controlled = bool(args.get('readahead_controlled'))
+        if depth or controlled:
             from petastorm_tpu.readers.readahead import RowGroupReadahead
             # the background thread gets its own handle cache: a ParquetFile
             # must never serve two concurrent reads
@@ -189,7 +193,14 @@ class ParquetPieceWorker(WorkerBase):
             self._readahead = RowGroupReadahead(
                 self._readahead_read, depth, trace=self.tracing_enabled,
                 beat=(lambda stage: self.beat_entity(readahead_entity, stage))
-                if self.health_enabled else None)
+                if self.health_enabled else None,
+                controlled=controlled)
+
+    def set_readahead_depth(self, depth: int) -> None:
+        """Live-set the prefetch depth (the autotune controller's actuator);
+        no-op for workers built without the readahead machinery."""
+        if self._readahead is not None:
+            self._readahead.set_depth(depth)
 
     def shutdown(self):
         if self._readahead is not None:

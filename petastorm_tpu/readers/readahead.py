@@ -74,7 +74,10 @@ class RowGroupReadahead:
 
     :param read_fn: ``read_fn(piece, columns) -> pa.Table``; runs **only** on
         the background thread (it must use its own file handles).
-    :param depth: max outstanding prefetched reads, or ``'auto'``.
+    :param depth: max outstanding prefetched reads, or ``'auto'``. ``0`` is
+        **dormant**: the machinery exists (hints flow, :meth:`set_depth` can
+        activate it live) but nothing is prefetched — the shape the autotune
+        controller constructs when the reader starts with readahead off.
     :param trace: record a ``readahead_read`` span per background read
         (stamped with the background thread's track, drained into the worker
         alongside the stats).
@@ -83,16 +86,22 @@ class RowGroupReadahead:
         heartbeat records as a ``readahead-<id>`` entity; see
         :mod:`petastorm_tpu.health`). Called from the background thread —
         must be cross-thread safe (``WorkerBase.beat_entity`` is).
+    :param controlled: the depth is **controller-owned**
+        (:mod:`petastorm_tpu.autotune`): the local auto-retune never runs —
+        two controllers adjusting one knob would oscillate — and only
+        :meth:`set_depth` moves it.
     """
 
-    def __init__(self, read_fn, depth, trace: bool = False, beat=None):
-        if depth != 'auto' and (not isinstance(depth, int) or depth < 1):
+    def __init__(self, read_fn, depth, trace: bool = False, beat=None,
+                 controlled: bool = False):
+        if depth != 'auto' and (not isinstance(depth, int) or depth < 0):
             raise ValueError(
-                "readahead depth must be a positive int or 'auto', got "
+                "readahead depth must be a non-negative int or 'auto', got "
                 '{!r}'.format(depth))
         self._read_fn = read_fn
-        self._auto = depth == 'auto'
-        self._depth = AUTO_INITIAL_DEPTH if self._auto else depth
+        self._controlled = controlled
+        self._auto = depth == 'auto' and not controlled
+        self._depth = AUTO_INITIAL_DEPTH if depth == 'auto' else depth
         self._trace = trace
         self._beat = beat
         self._lock = threading.Lock()
@@ -115,9 +124,24 @@ class RowGroupReadahead:
 
     @property
     def depth(self) -> int:
-        """Current target depth (fixed, or the live auto-tuned value)."""
+        """Current target depth (fixed, live auto-tuned, or
+        controller-set)."""
         with self._lock:
             return self._depth
+
+    def set_depth(self, depth: int) -> None:
+        """Pin the target depth live (the autotune controller's actuator).
+
+        Pinning disables the local auto-retune for good — once a controller
+        owns the knob, two tuners must never fight over it. ``0`` makes the
+        readahead dormant (outstanding reads drain normally, new ones are
+        not scheduled); a later positive depth re-activates it."""
+        if not isinstance(depth, int) or depth < 0:
+            raise ValueError('readahead depth must be a non-negative int, '
+                             'got {!r}'.format(depth))
+        with self._lock:
+            self._auto = False
+            self._depth = min(depth, AUTO_MAX_DEPTH)
 
     def _retune_locked(self) -> None:
         if not self._auto or self._read_samples < 2 or self._gap_samples < 2:
@@ -176,7 +200,10 @@ class RowGroupReadahead:
             if self._scheduled and self._scheduled[0].key == key:
                 entry = self._scheduled.popleft()
             if entry is None:
-                self._stats_counts['readahead_misses'] += 1
+                if self._depth > 0:
+                    # a dormant (depth-0) readahead never prefetches, so an
+                    # inline read is its contract, not a miss to diagnose
+                    self._stats_counts['readahead_misses'] += 1
                 # inline read follows; its end time is unknown — skip the
                 # next decode-gap sample rather than pollute it
                 self._last_serve_end = None

@@ -55,6 +55,7 @@ DEFAULT_TARGET_MODULES = (
     'petastorm_tpu.tracing',
     'petastorm_tpu.lineage',
     'petastorm_tpu.latency',
+    'petastorm_tpu.autotune',
     'petastorm_tpu.workers.thread_pool',
     'petastorm_tpu.workers.stats',
     'petastorm_tpu.workers.ventilator',

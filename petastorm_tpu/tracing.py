@@ -243,10 +243,13 @@ def prometheus_text(snapshot: dict, prefix: str = 'petastorm_tpu') -> str:
     ``# HELP`` line, and non-finite values use the spec's
     ``NaN``/``+Inf``/``-Inf`` literals.
 
-    One string key is special-cased: ``binding_stage`` (the roofline
-    profiler's verdict — see ``docs/profiling.md``) exports as an
-    info-style labeled gauge ``<prefix>_binding_stage{stage="decode"} 1``,
-    the Prometheus idiom for categorical state.
+    Two string keys are special-cased as info-style labeled gauges (the
+    Prometheus idiom for categorical state): ``binding_stage`` (the
+    roofline profiler's verdict — see ``docs/profiling.md``) exports as
+    ``<prefix>_binding_stage{stage="decode"} 1``, and
+    ``autotune_last_knob`` (the controller's most recent move — see
+    ``docs/autotune.md``) as
+    ``<prefix>_autotune_last_knob{knob="workers_count:up"} 1``.
 
     When the snapshot carries the latency plane's histogram states (the
     ``'_latency_histograms'`` key a ``ReaderStats`` snapshot includes unless
@@ -277,6 +280,13 @@ def prometheus_text(snapshot: dict, prefix: str = 'petastorm_tpu') -> str:
                          .format(metric))
             lines.append('# TYPE {} gauge'.format(metric))
             lines.append('{}{{stage="{}"}} 1'.format(metric, value))
+            continue
+        if key == 'autotune_last_knob' and isinstance(value, str) and value:
+            metric = '{}_{}'.format(prefix, key)
+            lines.append('# HELP {} the autotune controller\'s most recent '
+                         'knob move (see docs/autotune.md)'.format(metric))
+            lines.append('# TYPE {} gauge'.format(metric))
+            lines.append('{}{{knob="{}"}} 1'.format(metric, value))
             continue
         if not isinstance(value, (int, float)) or isinstance(value, bool):
             continue

@@ -55,6 +55,17 @@ _LOCALHOST = 'tcp://127.0.0.1'
 _DATA = 'DATA'
 _FINISHED = 'FINISHED'
 
+#: Work-queue marker requesting a clean worker retirement (live shrink,
+#: docs/autotune.md): the receiving worker processes everything it already
+#: holds, acks with :class:`_WorkerRetired`, and exits 0. Sent only after the
+#: pool quiesced (ventilator paused, in-flight drained), so retirement can
+#: never orphan a ventilated item.
+_RETIRE = 'RETIRE'
+
+#: Control-channel (PUB) marker carrying a live readahead-depth change to
+#: every worker interpreter: ``(_SET_READAHEAD, depth)``.
+_SET_READAHEAD = 'SET_READAHEAD'
+
 #: Below this total payload size the worker lets ZMQ copy at send time:
 #: zero-copy sends carry per-message bookkeeping (a free-fn callback and a
 #: gc-pinned buffer) that only pays for itself on large frames.
@@ -67,6 +78,15 @@ class _WorkerStarted:
 
 
 class _WorkerTerminated:
+    def __init__(self, worker_id):
+        self.worker_id = worker_id
+
+
+class _WorkerRetired:
+    """Ack of a :data:`_RETIRE` marker: the worker finished everything it
+    held, ran its shutdown hooks, and is exiting cleanly (exit code 0 — the
+    liveness check must never read a retirement as a death)."""
+
     def __init__(self, worker_id):
         self.worker_id = worker_id
 
@@ -114,6 +134,19 @@ class ProcessPool:
         #: :class:`~petastorm_tpu.lineage.LineageEnvelope` on this side.
         self.lineage = None
         self._processes = []
+        self._procs_by_worker_id = {}
+        self._next_worker_id = workers_count
+        self._spawn_args = None
+        self._readahead_depth_override = None
+        # serializes concurrent resize calls; never nested with the
+        # accounting lock's hot-path uses (resize is controller-thread-only)
+        self._resize_lock = threading.Lock()
+        # the control PUB socket is shared by stop()'s FINISHED broadcast
+        # (consumer thread) and set_readahead_depth (controller thread);
+        # ZMQ sockets are not thread-safe, so every send on it holds this
+        # mutex (sends are to an in-proc queue — never a blocking wait)
+        self._control_mutex = threading.Lock()
+        self._retired_ack_ids = []
         self._ventilator = None
         self._context = None
         self._work_sender = None
@@ -153,15 +186,12 @@ class ProcessPool:
         self._poller = zmq.Poller()
         self._poller.register(self._results_receiver, zmq.POLLIN)
 
+        self._spawn_args = (worker_class, worker_args,
+                            '{}:{}'.format(_LOCALHOST, work_port),
+                            '{}:{}'.format(_LOCALHOST, control_port),
+                            '{}:{}'.format(_LOCALHOST, results_port))
         for worker_id in range(self._workers_count):
-            proc = exec_in_new_process(
-                _worker_bootstrap,
-                args=(worker_class, worker_id, worker_args, self._serializer,
-                      '{}:{}'.format(_LOCALHOST, work_port),
-                      '{}:{}'.format(_LOCALHOST, control_port),
-                      '{}:{}'.format(_LOCALHOST, results_port),
-                      os.getpid()))
-            self._processes.append(proc)
+            self._spawn_worker(worker_id)
 
         # Startup barrier: all workers must report in before we ventilate
         # (reference process_pool.py:200-213).
@@ -186,6 +216,158 @@ class ProcessPool:
         self._ventilator = ventilator
         if ventilator is not None:
             ventilator.start()
+
+    def _spawn_worker(self, worker_id: int) -> None:
+        worker_class, worker_args, work_addr, control_addr, results_addr = \
+            self._spawn_args
+        if self._readahead_depth_override is not None \
+                and isinstance(worker_args, dict):
+            # a grow after a live set_readahead_depth must not resurrect the
+            # construction-time depth: the PUB broadcast only reaches
+            # workers whose SUB socket already joined, so the newcomer gets
+            # the current depth in its spawn args instead
+            worker_args = dict(worker_args,
+                               io_readahead=self._readahead_depth_override)
+        proc = exec_in_new_process(
+            _worker_bootstrap,
+            args=(worker_class, worker_id, worker_args, self._serializer,
+                  work_addr, control_addr, results_addr, os.getpid()))
+        # copy-on-write rebind: readers (_check_workers_alive on the
+        # consumer thread) iterate whatever list object they grabbed
+        self._processes = self._processes + [proc]
+        self._procs_by_worker_id[worker_id] = proc
+
+    # -- live resize (the autotune controller's actuator; docs/autotune.md) ----
+
+    def resize(self, workers_count: int, timeout_s: float = 30.0) -> int:
+        """Live-resize the pool to ``workers_count`` worker interpreters.
+
+        Growing spawns fresh workers through the existing bootstrap (they
+        connect to the same sockets; ZMQ starts round-robining work to them
+        as soon as they report in). Shrinking is **drain-then-retire**: the
+        ventilator is paused, in-flight items drain to zero (the consumer
+        keeps pulling results on its own thread), then :data:`_RETIRE`
+        markers go out on the work socket — each is consumed by exactly one
+        worker, which acks with :class:`_WorkerRetired` and exits 0. The
+        retirement is a *clean handback*: no ventilated item is ever in
+        flight toward a retiring worker, so the lineage ``CoverageAuditor``
+        sees exactly-once delivery (contrast the killed-worker path, whose
+        in-flight items surface as *reported drops*). Acks drain through
+        ``get_results``; this thread reaps the exited interpreters (join
+        off the hot path) and the ventilator resumes, redistributing all
+        future items over the remaining workers.
+
+        A quiesce or ack that cannot complete within ``timeout_s`` aborts
+        the shrink safely (ventilator resumed, count untouched; a late ack
+        still adjusts the count truthfully when it lands). Returns the live
+        worker count."""
+        if not isinstance(workers_count, int) or workers_count < 1:
+            raise ValueError('workers_count must be a positive int, got '
+                             '{!r}'.format(workers_count))
+        with self._resize_lock:
+            if self._stopped or self._spawn_args is None:
+                return self._workers_count
+            current = self._workers_count
+            if workers_count > current:
+                for _ in range(workers_count - current):
+                    worker_id = self._next_worker_id
+                    self._next_worker_id += 1
+                    self._spawn_worker(worker_id)
+                with self._accounting_lock:
+                    self._workers_count += workers_count - current
+                return self._workers_count
+            if workers_count < current:
+                self._retire_workers(current - workers_count, timeout_s)
+            return self._workers_count
+
+    def _retire_workers(self, k: int, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        vent = self._ventilator
+        pause = getattr(vent, 'pause', None)
+        if pause is not None:
+            pause()
+        in_flight = None
+        acked = False
+        try:
+            # quiesce: no new ventilation, in-flight drains to zero — only
+            # then can a retire marker be the sole message on the work
+            # socket (nothing can be lost in a retiring worker's pipe).
+            # BOTH counters must settle: the ventilator's own in_flight is
+            # incremented BEFORE the work-socket send, so it covers the
+            # admitted-but-not-yet-sent window the pool accounting misses
+            # (and proves no other thread is mid-send on the PUSH socket
+            # when the markers go out).
+            while time.monotonic() < deadline:
+                with self._accounting_lock:
+                    in_flight = self._ventilated_items - self._processed_items
+                vent_in_flight = getattr(vent, 'in_flight', 0) if vent else 0
+                if in_flight == 0 and vent_in_flight == 0:
+                    break
+                time.sleep(0.02)
+            else:
+                logger.warning('pool shrink aborted: %d items still in '
+                               'flight after %.1fs', in_flight, timeout_s)
+                return
+            target = self._workers_count - k
+            for _ in range(k):
+                self._work_sender.send_pyobj(_RETIRE)
+            # acks drain through get_results (consumer thread); each one
+            # decrements the live count the moment it lands
+            while time.monotonic() < deadline:
+                with self._accounting_lock:
+                    if self._workers_count <= target:
+                        acked = True
+                        break
+                time.sleep(0.02)
+            self.reap_retired(max(0.0, deadline - time.monotonic()))
+        finally:
+            if not acked:
+                # a marker may still be unconsumed (e.g. the consumer is
+                # not draining acks): give the retiring interpreter's
+                # disconnect a moment to propagate to the PUSH side before
+                # new items may ventilate, so round-robin cannot route one
+                # into a closing pipe; the worker's own final drain (see
+                # _worker_bootstrap) covers the other side of this window
+                time.sleep(0.25)
+            resume = getattr(vent, 'resume', None)
+            if resume is not None:
+                resume()
+
+    def _on_worker_retired(self, worker_id) -> None:
+        """Consumer-thread handler for a :class:`_WorkerRetired` ack: adjust
+        the live count; the actual process reap happens off the hot path in
+        :meth:`reap_retired`."""
+        with self._accounting_lock:
+            self._workers_count = max(0, self._workers_count - 1)
+            self._retired_ack_ids.append(worker_id)
+
+    def reap_retired(self, timeout_s: float = 10.0) -> int:
+        """Wait out (and drop) the processes of acked retirements; returns
+        how many were reaped. An acked retiree has already exited, so the
+        waits settle immediately — cheap enough for the teardown path."""
+        with self._accounting_lock:
+            acked, self._retired_ack_ids = self._retired_ack_ids, []
+        deadline = time.monotonic() + timeout_s
+        for worker_id in acked:
+            proc = self._procs_by_worker_id.pop(worker_id, None)
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            self._processes = [p for p in self._processes if p is not proc]
+        return len(acked)
+
+    def set_readahead_depth(self, depth: int) -> None:
+        """Broadcast a live readahead-depth change to every worker
+        interpreter over the control channel (the same PUB socket the stop
+        broadcast uses; serialized against it by the control mutex); workers
+        spawned by a later grow inherit it via their spawn args."""
+        self._readahead_depth_override = int(depth)
+        with self._control_mutex:
+            if self._control_sender is not None and not self._stopped:
+                self._control_sender.send_pyobj((_SET_READAHEAD, int(depth)))
 
     def _recv_multipart(self):
         """Receive one ``[meta, control, buf0..bufN]`` message; returns
@@ -263,6 +445,11 @@ class ProcessPool:
                 raise control.exc
             if isinstance(control, _WorkerHeartbeat):
                 self._merge_heartbeats(control.records)
+                continue
+            if isinstance(control, _WorkerRetired):
+                # live-shrink ack (see resize): adjust the count here, reap
+                # the interpreter off the hot path on the resizing thread
+                self._on_worker_retired(control.worker_id)
                 continue
             provenance = None
             if isinstance(control, tuple) and len(control) == 2 \
@@ -356,15 +543,26 @@ class ProcessPool:
         self._stopped = True
         if self._ventilator is not None:
             self._ventilator.stop()
+        # acked retirees already exited 0 but may still sit in
+        # self._processes until the next controller reap — count them out
+        # now or the termination wait below spins its full timeout
+        self.reap_retired(timeout_s=2.0)
         # Repeated FINISHED broadcast beats the PUB/SUB slow-joiner race
         # (reference process_pool.py:284-301). Drain results while waiting.
         deadline = time.monotonic() + _SHUTDOWN_TIMEOUT_S
         while self._terminated_workers < len(self._processes) and time.monotonic() < deadline:
-            self._control_sender.send_pyobj(_FINISHED)
+            with self._control_mutex:
+                self._control_sender.send_pyobj(_FINISHED)
             if dict(self._poller.poll(50)):
                 try:
                     _, control = self._recv_multipart()
                     if isinstance(control, _WorkerTerminated):
+                        self._terminated_workers += 1
+                    elif isinstance(control, _WorkerRetired):
+                        # a late shrink ack arriving during teardown: that
+                        # worker is exiting too — count it or the loop
+                        # waits out the full timeout for a ghost
+                        self._on_worker_retired(control.worker_id)
                         self._terminated_workers += 1
                 # teardown drain: ANY failure here means the transport is
                 # closing under us, which is the condition being handled —
@@ -560,22 +758,52 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
     from collections import deque
     pending = deque()
     hint = getattr(worker, 'prefetch_hint', None)
+    retiring = False
     try:
         while True:
             # block only when there is nothing to process; otherwise just
             # drain whatever already arrived
             socks = dict(poller.poll(None if not pending else 0))
             if control_receiver in socks:
-                if control_receiver.recv_pyobj() == _FINISHED:
+                msg = control_receiver.recv_pyobj()
+                if msg == _FINISHED:
                     break   # drop un-processed lookahead items: pool stopping
-            if work_receiver in socks:
+                if (isinstance(msg, tuple) and len(msg) == 2
+                        and msg[0] == _SET_READAHEAD):
+                    # live knob broadcast (docs/autotune.md): applied between
+                    # items on the worker's own thread
+                    setter = getattr(worker, 'set_readahead_depth', None)
+                    if setter is not None:
+                        setter(msg[1])
+            if work_receiver in socks and not retiring:
                 lookahead = getattr(worker, 'prefetch_lookahead', 0)
                 while len(pending) - 1 < lookahead:
                     try:
-                        pending.append(
-                            work_receiver.recv_pyobj(zmq.NOBLOCK))
+                        entry = work_receiver.recv_pyobj(zmq.NOBLOCK)
                     except zmq.Again:
                         break
+                    if entry == _RETIRE:
+                        # clean retirement: stop pulling, finish what we
+                        # hold, ack, exit 0 (see ProcessPool.resize)
+                        retiring = True
+                        break
+                    pending.append(entry)
+            if retiring and not pending:
+                # final drain: anything that slipped into our pipe behind
+                # the marker is processed, not orphaned (the quiesce makes
+                # this empty in the normal path; a timed-out shrink that
+                # resumed ventilation early is the case this covers)
+                while True:
+                    try:
+                        entry = work_receiver.recv_pyobj(zmq.NOBLOCK)
+                    except zmq.Again:
+                        break
+                    if entry != _RETIRE:
+                        pending.append(entry)
+                if pending:
+                    continue
+                send([b''], _WorkerRetired(worker_id))
+                break
             if not pending:
                 continue
             if hint is not None:
@@ -658,7 +886,11 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
         if hb_thread is not None:
             hb_thread.join(timeout=5)
         worker.shutdown()
-        send([b''], _WorkerTerminated(worker_id))
+        if not retiring:
+            # a retiree already acked with _WorkerRetired; a second
+            # terminated frame would let stop() double-count it and exit
+            # its broadcast loop before live workers acked
+            send([b''], _WorkerTerminated(worker_id))
         for sock in (work_receiver, control_receiver, results_sender):
             sock.close(linger=1000)
         context.term()

@@ -33,6 +33,19 @@ _SENTINEL = object()
 _RESULTS_QUEUE_SIZE_DEFAULT = 50
 
 
+class _RetireSentinel:
+    """A targeted shrink request on the shared work queue: whichever worker
+    pops it finishes the items it already holds (the clean handback — its
+    pending lookahead FIFO is processed, never dropped), then exits. The
+    ``done`` event lets :meth:`ThreadPool.reap_retired` join off the hot
+    path."""
+
+    __slots__ = ('done',)
+
+    def __init__(self):
+        self.done = threading.Event()
+
+
 class _WorkerException:
     """An exception captured on a worker, shipped with its formatted traceback."""
 
@@ -64,14 +77,25 @@ class WorkerThread(threading.Thread):
         hint = getattr(self._worker, 'prefetch_hint', None)
         beat = getattr(self._worker, 'beat', None)
         item_done = getattr(self._worker, 'item_done', None)
+        retire = None
         try:
             while True:
+                if retire is not None and not pending:
+                    # clean retirement: every item this worker had already
+                    # pulled has been processed and published — nothing was
+                    # handed back by dropping (docs/autotune.md)
+                    break
                 if not pending:
                     item = self._pool._work_queue.get()
                     if item is _SENTINEL:
                         break
+                    if isinstance(item, _RetireSentinel):
+                        retire = item
+                        continue
                     pending.append(item)
-                lookahead = getattr(self._worker, 'prefetch_lookahead', 0)
+                lookahead = (0 if retire is not None
+                             else getattr(self._worker,
+                                          'prefetch_lookahead', 0))
                 saw_sentinel = False
                 while lookahead and len(pending) - 1 < lookahead:
                     try:
@@ -80,6 +104,10 @@ class WorkerThread(threading.Thread):
                         break
                     if extra is _SENTINEL:
                         saw_sentinel = True
+                        break
+                    if isinstance(extra, _RetireSentinel):
+                        # stop pulling new work; finish pending, then exit
+                        retire = extra
                         break
                     pending.append(extra)
                 if saw_sentinel:
@@ -146,6 +174,8 @@ class WorkerThread(threading.Thread):
                 self._profiler.disable()
                 self._pool._collect_profile(self._profiler)
             self._worker.shutdown()
+            if retire is not None:
+                self._pool._worker_retired(self, retire)
 
 
 class ThreadPool:
@@ -173,6 +203,21 @@ class ThreadPool:
         self._stop_event = threading.Event()
         self._threads = []
         self._workers = []
+        # membership lock for the thread/worker lists: resize (controller
+        # thread) mutates them while stop()/heartbeats() (other threads)
+        # iterate. Bodies under it are pure list/dict work — never a queue
+        # op or a join (petalint R3).
+        self._membership_lock = threading.Lock()
+        # serializes resize against stop(): a grow that raced shutdown
+        # would spawn a worker no stop sentinel ever covers (sentinel
+        # counting and spawning must see a consistent stop flag); queue
+        # puts happen outside it
+        self._resize_mutex = threading.Lock()
+        self._retired_threads = []
+        self._pending_retires = []
+        self._next_worker_id = workers_count
+        self._start_args = None
+        self._readahead_depth_override = None
         self._ventilator = None
         self._accounting_lock = threading.Lock()
         self._ventilated_items = 0
@@ -185,28 +230,146 @@ class ThreadPool:
 
     def start(self, worker_class, worker_args=None, ventilator=None):
         self._ventilator = ventilator
+        self._start_args = (worker_class, worker_args)
         for worker_id in range(self._workers_count):
-            # Per-worker publish wrapper: time spent blocked on a full results
-            # queue is back-pressure, not decode; the worker thread subtracts
-            # it from its process() wall time. The worker is constructed with
-            # the wrapper, so its beat fn arrives via the holder afterwards.
-            publish_wait = {'s': 0.0}
-            holder = {}
-
-            def publish(item, _wait=publish_wait, _holder=holder):
-                start = time.perf_counter()
-                self._put_result(item, beat=_holder.get('beat'))
-                _wait['s'] += time.perf_counter() - start
-
-            worker = worker_class(worker_id, publish, worker_args)
-            holder['beat'] = getattr(worker, 'beat', None)
-            self._workers.append(worker)
-            thread = WorkerThread(self, worker, self._profiling_enabled,
-                                  publish_wait)
-            self._threads.append(thread)
-            thread.start()
+            self._spawn_worker(worker_id)
         if ventilator is not None:
             ventilator.start()
+
+    def _spawn_worker(self, worker_id: int) -> None:
+        worker_class, worker_args = self._start_args
+        if self._readahead_depth_override is not None \
+                and isinstance(worker_args, dict):
+            # a grow after a live set_readahead_depth must not resurrect
+            # the construction-time depth: bake the current one into the
+            # newcomer's args (the broadcast path only reaches workers
+            # that already exist)
+            worker_args = dict(worker_args,
+                               io_readahead=self._readahead_depth_override)
+        # Per-worker publish wrapper: time spent blocked on a full results
+        # queue is back-pressure, not decode; the worker thread subtracts
+        # it from its process() wall time. The worker is constructed with
+        # the wrapper, so its beat fn arrives via the holder afterwards.
+        publish_wait = {'s': 0.0}
+        holder = {}
+
+        def publish(item, _wait=publish_wait, _holder=holder):
+            start = time.perf_counter()
+            self._put_result(item, beat=_holder.get('beat'))
+            _wait['s'] += time.perf_counter() - start
+
+        worker = worker_class(worker_id, publish, worker_args)
+        holder['beat'] = getattr(worker, 'beat', None)
+        thread = WorkerThread(self, worker, self._profiling_enabled,
+                              publish_wait)
+        with self._membership_lock:
+            self._workers.append(worker)
+            self._threads.append(thread)
+        thread.start()
+
+    # -- live resize (the autotune controller's actuator; docs/autotune.md) ----
+
+    def resize(self, workers_count: int, timeout_s: float = 30.0) -> int:
+        """Live-resize the pool to ``workers_count`` workers.
+
+        Growing spawns named worker threads immediately. Shrinking enqueues
+        retire sentinels on the shared work queue: whichever workers pop
+        them finish every item they already hold (a clean handback — the
+        lineage audit sees each of those items delivered exactly once, never
+        dropped), publish their final drained stats, run ``shutdown()`` and
+        exit. Retired threads are joined off the hot path — here, bounded by
+        ``timeout_s``, and again by :meth:`join`. Returns the new target
+        count."""
+        if not isinstance(workers_count, int) or workers_count < 1:
+            raise ValueError('workers_count must be a positive int, got '
+                             '{!r}'.format(workers_count))
+        sentinels = []
+        with self._resize_mutex:
+            if self._stop_event.is_set():
+                return self._workers_count
+            delta = workers_count - self._workers_count
+            if delta > 0:
+                for _ in range(delta):
+                    worker_id = self._next_worker_id
+                    self._next_worker_id += 1
+                    self._spawn_worker(worker_id)
+            elif delta < 0:
+                sentinels = [_RetireSentinel() for _ in range(-delta)]
+                with self._membership_lock:
+                    self._pending_retires.extend(sentinels)
+            self._workers_count = workers_count
+        for sentinel in sentinels:
+            self._work_queue.put(sentinel)
+        if sentinels:
+            self.reap_retired(timeout_s)
+        return self._workers_count
+
+    def _worker_retired(self, thread: 'WorkerThread', sentinel) -> None:
+        """Called by a retiring worker thread as its last act: move it to
+        the retired list (``reap_retired``/``join`` own the joining — a
+        thread never joins itself)."""
+        with self._membership_lock:
+            if thread in self._threads:
+                self._threads.remove(thread)
+            if thread._worker in self._workers:
+                self._workers.remove(thread._worker)
+            if sentinel in self._pending_retires:
+                self._pending_retires.remove(sentinel)
+            self._retired_threads.append(thread)
+        sentinel.done.set()
+
+    def reap_retired(self, timeout_s: float = 10.0) -> int:
+        """Join retired worker threads (bounded); returns how many are still
+        pending retirement (0 = fully settled). Safe from any thread except
+        a worker's own."""
+        deadline = time.monotonic() + timeout_s
+        with self._membership_lock:
+            pending = list(self._pending_retires)
+        for sentinel in pending:
+            if self._stop_event.is_set():
+                # a stopping pool's workers exit via _SENTINEL and may never
+                # consume a pending retire — don't wait out the timeout on
+                # a sentinel that cannot complete
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            sentinel.done.wait(remaining)
+        with self._membership_lock:
+            retired, self._retired_threads = self._retired_threads, []
+            still_pending = len(self._pending_retires)
+        for thread in retired:
+            thread.join(timeout=max(0.0, deadline - time.monotonic()))
+        return still_pending
+
+    def set_readahead_depth(self, depth: int) -> None:
+        """Live-set every worker's readahead prefetch depth (no-op for
+        workers without the readahead machinery); workers spawned by a
+        later grow inherit it."""
+        self._readahead_depth_override = depth
+        with self._membership_lock:
+            workers = list(self._workers)
+        for worker in workers:
+            setter = getattr(worker, 'set_readahead_depth', None)
+            if setter is not None:
+                setter(depth)
+
+    def set_results_queue_bound(self, maxsize: int) -> None:
+        """Live-adjust the bounded results queue's capacity. Relies on
+        CPython's ``queue.Queue`` keeping ``maxsize`` as a plain attribute
+        guarded by ``mutex``; blocked putters are woken so an enlargement
+        takes effect immediately rather than at the next consumer get."""
+        if not isinstance(maxsize, int) or maxsize < 1:
+            raise ValueError('results queue bound must be a positive int, '
+                             'got {!r}'.format(maxsize))
+        q = self._results_queue
+        with q.mutex:
+            q.maxsize = maxsize
+            q.not_full.notify_all()
+
+    @property
+    def results_queue_bound(self) -> int:
+        return self._results_queue.maxsize
 
     def ventilate(self, *args, **kwargs):
         with self._accounting_lock:
@@ -292,12 +455,21 @@ class ThreadPool:
     def stop(self):
         if self._ventilator is not None:
             self._ventilator.stop()
-        self._stop_event.set()
-        for _ in self._threads:
+        # the resize mutex makes the stop flag + live-thread count atomic
+        # against a concurrent grow: a worker spawned before this point is
+        # counted (gets a sentinel), one after sees the flag and never
+        # spawns
+        with self._resize_mutex:
+            self._stop_event.set()
+            with self._membership_lock:
+                live_threads = len(self._threads)
+        for _ in range(live_threads):
             self._work_queue.put(_SENTINEL)
 
     def join(self):
-        for thread in self._threads:
+        with self._membership_lock:
+            threads = list(self._threads) + list(self._retired_threads)
+        for thread in threads:
             thread.join(timeout=10)
         if self._profiling_enabled and self._profiles:
             stats = None
@@ -319,7 +491,9 @@ class ThreadPool:
         """Live per-entity heartbeat records (workers run in-process, so
         their ``WorkerBase`` records are read directly — never stale)."""
         records = {}
-        for worker in self._workers:
+        with self._membership_lock:
+            workers = list(self._workers)
+        for worker in workers:
             snapshot = getattr(worker, 'heartbeat_snapshot', None)
             if snapshot is not None:
                 records.update(snapshot())

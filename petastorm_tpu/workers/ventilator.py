@@ -69,6 +69,7 @@ class BackPressuredVentilator(Ventilator):
         # this). processed_item() notifies, so a freed slot is re-filled
         # immediately; the timeout below only bounds stop-latency.
         self._slot_cv = threading.Condition()
+        self._paused = False
         self._stop_event = threading.Event()
         self._completed = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -98,7 +99,7 @@ class BackPressuredVentilator(Ventilator):
         first_wait = True
         with self._slot_cv:
             while not self._stop_event.is_set():
-                if self._in_flight < self._max_in_flight:
+                if not self._paused and self._in_flight < self._max_in_flight:
                     self._in_flight += 1
                     self._beat('ventilate')
                     return True
@@ -113,6 +114,46 @@ class BackPressuredVentilator(Ventilator):
         with self._slot_cv:
             self._in_flight -= 1
             self._slot_cv.notify()
+
+    # -- live actuation (the autotune controller's knobs; docs/autotune.md) ----
+
+    @property
+    def max_in_flight(self) -> int:
+        """Current in-flight bound (the live ventilation window)."""
+        with self._slot_cv:
+            return self._max_in_flight
+
+    def set_max_in_flight(self, bound: int) -> None:
+        """Live-adjust the in-flight bound. Shrinking never recalls items
+        already ventilated — the bound simply admits nothing new until
+        enough complete; growing wakes a back-pressured ventilator
+        immediately."""
+        if not isinstance(bound, int) or bound < 1:
+            raise ValueError('max_in_flight must be a positive int, got '
+                             '{!r}'.format(bound))
+        with self._slot_cv:
+            self._max_in_flight = bound
+            self._slot_cv.notify_all()
+
+    def pause(self) -> None:
+        """Stop admitting new items (in-flight ones complete normally) —
+        the quiesce half of the process pool's drain-then-retire shrink.
+        Idempotent; the pipeline's completion accounting is unaffected
+        (a paused mid-epoch ventilator never reads as completed)."""
+        with self._slot_cv:
+            self._paused = True
+
+    def resume(self) -> None:
+        """Undo :meth:`pause`; wakes the ventilator thread immediately."""
+        with self._slot_cv:
+            self._paused = False
+            self._slot_cv.notify_all()
+
+    @property
+    def in_flight(self) -> int:
+        """Items ventilated but not yet reported processed."""
+        with self._slot_cv:
+            return self._in_flight
 
     def completed(self) -> bool:
         # All items ventilated AND nothing still in flight.

@@ -32,7 +32,7 @@ import pytest  # noqa: E402
 #: test_tracing, promoted to a shared guard).
 _THREAD_GUARDED_MODULES = frozenset({
     'test_tracing', 'test_health', 'test_sharedcache', 'test_readahead',
-    'test_workers_pool', 'test_transport', 'test_latency',
+    'test_workers_pool', 'test_transport', 'test_latency', 'test_autotune',
 })
 
 #: Test modules that run under the lockdep-lite harness
@@ -42,6 +42,7 @@ _THREAD_GUARDED_MODULES = frozenset({
 #: production layer; ``ci/run_tests.sh`` runs these lanes with it on.
 _LOCKDEP_MODULES = frozenset({
     'test_sharedcache', 'test_health', 'test_workers_pool', 'test_latency',
+    'test_autotune',
 })
 
 

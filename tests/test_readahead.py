@@ -177,9 +177,14 @@ class TestRowGroupReadahead:
 
     def test_validates_depth(self):
         with pytest.raises(ValueError):
-            RowGroupReadahead(lambda p, c: None, depth=0)
+            RowGroupReadahead(lambda p, c: None, depth=-1)
         with pytest.raises(ValueError):
             RowGroupReadahead(lambda p, c: None, depth='warp')
+        # 0 is legal since the autotune controller: dormant machinery that
+        # set_depth() can activate live (docs/autotune.md)
+        dormant = RowGroupReadahead(lambda p, c: None, depth=0)
+        assert dormant.depth == 0
+        dormant.stop()
 
 
 def _reader_ids(url, **kwargs):
