@@ -319,6 +319,12 @@ def main(argv=None):
     # per-sample detail is artifact material, not headline JSON
     autotune_bench.get('recovered', {}).pop('timeline', None)
 
+    # -- chaos: hedged vs unhedged reads under injected tail latency --------
+    # Quick mode asserts hedges fire and recover the e2e p99; the headline
+    # >=2x recovery + <5% clean-path overhead live in BENCH_r16.json.
+    from petastorm_tpu.benchmark.chaos import run_chaos_bench
+    chaos_bench = run_chaos_bench(quick=True)
+
     # -- north-star: train-step infeed overlap ------------------------------
     # Accelerator-scale configs for any non-CPU backend; dataset paths carry
     # the size parameters so a platform change can't reuse a stale store.
@@ -510,6 +516,7 @@ def main(argv=None):
         'roofline_bench': roofline_bench,
         'decode_batch': decode_batch,
         'autotune': autotune_bench,
+        'chaos': chaos_bench,
         'northstar': {
             'platform': platform,
             'mnist_train': _with_roofline(mnist.as_dict(), mnist_roofline),

@@ -253,6 +253,32 @@ def _autotune_entries(artifact, round_no, blob):
     return entries
 
 
+def _chaos_entries(artifact, round_no, blob):
+    """Entries from the chaos benchmark (r16): the clean-path rate with the
+    fault plane ON (the rate a default reader actually gets — its fraction
+    of the fault-plane-off ceiling IS the overhead claim) and the hedged
+    rate under the injected tail (the tail-latency recovery the hedge
+    layer buys). The unhedged pass is context, not a series: it measures a
+    deliberately unprotected config."""
+    entries = []
+    config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+              'rows': blob.get('rows'),
+              'scenario': (blob.get('scenario') or {}).get('name')}
+    roof = blob.get('roofline') or {}
+    clean = blob.get('clean') or {}
+    rate = clean.get('fault_plane_on_rows_per_s')
+    if isinstance(rate, (int, float)):
+        entries.append(_entry(artifact, round_no, 'chaos.clean_fault_plane_on',
+                              config, rate,
+                              roofline_pct=roof.get('roofline_pct')))
+    hedged = blob.get('hedged') or {}
+    rate = hedged.get('rows_per_s')
+    if isinstance(rate, (int, float)):
+        entries.append(_entry(artifact, round_no, 'chaos.hedged_under_tail',
+                              config, rate))
+    return entries
+
+
 def _shared_cache_entries(artifact, round_no, blob):
     """Entries from the shared-cache protocol record (r11): the measured
     serial roofline and the aggregate fleet rate."""
@@ -300,6 +326,8 @@ def normalize_artifact(name: str, blob: dict):
         entries.extend(_decode_batch_entries(name, round_no, payload))
     elif payload.get('benchmark', '').startswith('autotune'):
         entries.extend(_autotune_entries(name, round_no, payload))
+    elif payload.get('benchmark', '') == 'chaos':
+        entries.extend(_chaos_entries(name, round_no, payload))
     elif 'baseline_items_per_s' in payload:
         entries.extend(_overhead_entries(name, round_no, payload))
     elif 'shared' in payload and 'roofline' in payload:

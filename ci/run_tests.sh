@@ -59,6 +59,15 @@ python -m pytest tests/test_lineage.py -q
 echo '== lineage-overhead quick bench (provenance+audit ledgers on vs off) =='
 python -m petastorm_tpu.benchmark.lineage_overhead --quick
 
+echo '== resilience quick checks (retry policy, hedging, fault injector) =='
+python -m pytest tests/test_resilience.py -q
+
+echo '== chaos matrix (seeded fault scenarios x both pool types, audit-complete; lockdep on) =='
+PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_chaos.py -q
+
+echo '== chaos quick bench (hedged vs unhedged reads under injected tail latency) =='
+python -m petastorm_tpu.benchmark.chaos --quick
+
 echo '== latency quick checks (histograms, rolling windows, SLO monitor, /slo; lockdep on) =='
 PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_latency.py -q
 

@@ -18,6 +18,7 @@ __all__ = ['make_reader', 'make_batch_reader', 'make_columnar_reader',
            'CoverageAuditor', 'Provenance', 'SharedRowGroupCache',
            'LatencyHistogram', 'SLOMonitor',
            'PipelineController',
+           'RetryPolicy', 'HedgedRead', 'FaultInjector',
            '__version__']
 
 
@@ -56,4 +57,10 @@ def __getattr__(name):
     if name == 'PipelineController':
         from petastorm_tpu.autotune import PipelineController
         return PipelineController
+    if name in ('RetryPolicy', 'HedgedRead'):
+        from petastorm_tpu import resilience
+        return getattr(resilience, name)
+    if name == 'FaultInjector':
+        from petastorm_tpu.faultfs import FaultInjector
+        return FaultInjector
     raise AttributeError('module {!r} has no attribute {!r}'.format(__name__, name))

@@ -36,68 +36,31 @@ import argparse
 import json
 import shutil
 import tempfile
-import threading
 import time
 
 import numpy as np
 
+from petastorm_tpu.faultfs import FaultInjector, FaultyFilesystem
 from petastorm_tpu.workers.stats import readahead_hit_rate
 
 _MB = 1024.0 * 1024.0
 
 
-class SlowFile:
-    """File wrapper adding a fixed latency per ``read()`` call (plus optional
-    per-byte bandwidth cost) and counting reads on the owning filesystem."""
-
-    def __init__(self, inner, owner: 'SlowFilesystem'):
-        self._inner = inner
-        self._owner = owner
-
-    def read(self, *args, **kwargs):
-        data = self._inner.read(*args, **kwargs)
-        self._owner.on_read(len(data) if data is not None else 0)
-        return data
-
-    def __getattr__(self, name):
-        return getattr(self._inner, name)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self._inner.close()
-
-
-class SlowFilesystem:
+class SlowFilesystem(FaultyFilesystem):
     """fsspec-filesystem wrapper whose opened files sleep
-    ``seconds_per_read`` on every ``read()`` call (and
-    ``seconds_per_mb / MB`` per byte). Thread-safe: the worker thread and the
-    readahead thread sleep independently, exactly like two in-flight remote
-    range requests."""
+    ``seconds_per_read`` on every ``read()`` call (and ``seconds_per_mb /
+    MB`` per byte) — the BENCH_r07 shim, now the ``fixed-latency`` scenario
+    of the general chaos injector (:mod:`petastorm_tpu.faultfs`).
+    Thread-safe: the worker thread and the readahead thread sleep
+    independently, exactly like two in-flight remote range requests."""
 
     def __init__(self, inner, seconds_per_read: float = 0.0,
                  seconds_per_mb: float = 0.0):
-        self._inner = inner
+        super().__init__(inner, FaultInjector(
+            'fixed-latency', seconds_per_read=seconds_per_read,
+            seconds_per_mb=seconds_per_mb))
         self.seconds_per_read = seconds_per_read
         self.seconds_per_mb = seconds_per_mb
-        self._lock = threading.Lock()
-        self.read_calls = 0
-        self.bytes_read = 0
-
-    def on_read(self, nbytes: int) -> None:
-        with self._lock:
-            self.read_calls += 1
-            self.bytes_read += nbytes
-        delay = self.seconds_per_read + nbytes / _MB * self.seconds_per_mb
-        if delay > 0:
-            time.sleep(delay)
-
-    def open(self, path, mode='rb', **kwargs):
-        return SlowFile(self._inner.open(path, mode, **kwargs), self)
-
-    def __getattr__(self, name):
-        return getattr(self._inner, name)
 
 
 def _decode_work_transform(seconds_per_group: float):

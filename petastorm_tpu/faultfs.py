@@ -1,0 +1,372 @@
+"""Deterministic fault injection for the read path: a seeded, scenario-driven
+filesystem wrapper plus the ``PETASTORM_TPU_CHAOS`` harness hook.
+
+Chaos-testing a storage pipeline only proves something when the chaos is
+**replayable**: a flake that cannot be re-run with the same fault sequence is
+a bug report nobody can act on. Every injection decision here is a pure
+function of ``(seed, path, operation, occurrence-index)`` — run the same
+scenario with the same seed over the same access sequence and the exact same
+reads fail, straggle, truncate or kill. This generalizes the ad-hoc slow-IO
+shim ``benchmark/readahead.py`` grew for BENCH_r07 (which is now a
+fixed-latency scenario of this module) into the full fault model:
+
+========================  ====================================================
+scenario                  injected faults
+========================  ====================================================
+``transient-errors``      ``read()`` raises ``OSError(EIO)`` at ``error_rate``
+                          (then a ``cooldown_reads`` clean window per file —
+                          one row-group read spans MANY ``read()`` calls, so
+                          a bounded retry provably recovers, which is the
+                          property under test)
+``tail-latency``          every read pays ``base_latency_s``; a ``tail_rate``
+                          fraction pays ``tail_latency_s`` (heavy-tailed
+                          first-byte latency — the hedging benchmark's store)
+``read-hangs``            a ``hang_rate`` fraction of reads sleep ``hang_s``
+                          (the straggler/wedge shape hedges + watchdogs see)
+``truncated-reads``       a ``truncate_rate`` fraction of reads return short
+                          data (corrupts the Arrow stream mid-parse; the
+                          retry layer re-reads through a fresh handle)
+``worker-kill``           after ``kill_after_reads`` reads, raise
+                          :class:`SimulatedWorkerCrash` (at most ``max_kills``
+                          per process) — kills the worker thread/process from
+                          *inside* the read path
+``cache-enospc``          shared-cache segment publication raises
+                          ``OSError(ENOSPC)`` at ``enospc_rate`` (the cache
+                          degrades to direct decode; see ``docs/cache.md``)
+========================  ====================================================
+
+Harness hook: set ``PETASTORM_TPU_CHAOS='<scenario>:<seed>'`` (e.g.
+``transient-errors:1234``) and every :class:`ParquetPieceWorker` wraps its
+filesystem in the scenario — including workers in **spawned process
+interpreters**, which inherit the env var. Reader construction (metadata,
+footers) stays clean: chaos arms exactly under the worker read path the
+resilience layer protects. ``docs/robustness.md`` has the fault-model table
+and the CI chaos-lane recipe.
+"""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import logging
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+#: Environment variable arming the chaos harness: ``'<scenario>:<seed>'``
+#: (seed optional, default 0). Parsed once per process, cached.
+CHAOS_ENV_VAR = 'PETASTORM_TPU_CHAOS'
+
+#: Scenario registry: name -> default params. Every param can be overridden
+#: by constructing a :class:`FaultInjector` directly (benchmarks do).
+SCENARIOS: Dict[str, dict] = {
+    'none': {},
+    'transient-errors': dict(error_rate=0.25, cooldown_reads=64),
+    'tail-latency': dict(base_latency_s=0.0, tail_rate=0.05,
+                         tail_latency_s=0.25),
+    'read-hangs': dict(hang_rate=0.03, hang_s=1.0, cooldown_reads=64),
+    'truncated-reads': dict(truncate_rate=0.2, cooldown_reads=64),
+    'worker-kill': dict(kill_after_reads=5, max_kills=1),
+    'cache-enospc': dict(enospc_rate=1.0),
+    # the BENCH_r07 slow-IO shim as a scenario: every read pays a fixed
+    # latency (plus an optional per-byte bandwidth cost), faultlessly —
+    # what benchmark/readahead.py's SlowFilesystem now resolves to
+    'fixed-latency': dict(seconds_per_read=0.0, seconds_per_mb=0.0),
+}
+
+
+class SimulatedWorkerCrash(SystemExit):
+    """An injected worker death. ``SystemExit`` by design: no ``except
+    Exception`` handler on the worker path may swallow it — a thread worker
+    dies exactly like one hit by an async kill, and a process worker's
+    interpreter exits nonzero so the parent's liveness check fires."""
+
+
+class FaultInjector:
+    """Seeded, replayable fault decisions keyed by (path, op, occurrence).
+
+    Thread-safe: the worker thread and its background readahead thread share
+    one instance (they share the wrapped filesystem). Per-(path, op)
+    occurrence counters make decisions deterministic for a given access
+    sequence; ``max_consecutive`` caps back-to-back failures per path so a
+    bounded retry provably recovers.
+    """
+
+    def __init__(self, scenario: str = 'none', seed: int = 0, **overrides):
+        if scenario not in SCENARIOS:
+            raise ValueError('unknown chaos scenario {!r}; valid: {}'.format(
+                scenario, sorted(SCENARIOS)))
+        params = dict(SCENARIOS[scenario])
+        unknown = set(overrides) - set(params)
+        if unknown:
+            raise ValueError('unknown {} param(s) {}; valid: {}'.format(
+                scenario, sorted(unknown), sorted(params)))
+        params.update(overrides)
+        self.scenario = scenario
+        self.seed = int(seed)
+        self.params = params
+        self._lock = threading.Lock()
+        self._occurrences: Dict[tuple, int] = {}
+        self._cooldown: Dict[str, int] = {}
+        self._kills = 0
+        self._reads = 0
+        #: Injection tally by fault kind (diagnostics + test assertions).
+        self.injected: Dict[str, int] = {}
+
+    # -- decisions -------------------------------------------------------------
+
+    def _occurrence(self, path: str, op: str) -> int:
+        key = (path, op)
+        with self._lock:
+            n = self._occurrences.get(key, 0)
+            self._occurrences[key] = n + 1
+        return n
+
+    def _uniform(self, path: str, op: str, occurrence: int) -> float:
+        """Deterministic uniform [0, 1) draw for one decision point."""
+        token = '{}:{}:{}:{}'.format(self.seed, os.path.basename(path), op,
+                                     occurrence)
+        digest = hashlib.md5(token.encode()).digest()
+        return int.from_bytes(digest[:8], 'big') / float(1 << 64)
+
+    def _count(self, kind: str) -> None:
+        with self._lock:
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def _in_cooldown(self, path: str) -> bool:
+        """True (and consume one cooldown tick) while ``path`` is inside
+        the clean window an injected fault opened. One row-group read spans
+        many ``read()`` calls, so the window is sized in reads
+        (``cooldown_reads``) — a bounded retry of the whole operation lands
+        inside it and provably recovers."""
+        if 'cooldown_reads' not in self.params:
+            return False
+        with self._lock:
+            remaining = self._cooldown.get(path, 0)
+            if remaining > 0:
+                self._cooldown[path] = remaining - 1
+                return True
+        return False
+
+    def _mark_fault(self, path: str) -> None:
+        with self._lock:
+            self._cooldown[path] = int(self.params['cooldown_reads'])
+
+    # -- fs-side hooks ---------------------------------------------------------
+
+    def before_read(self, path: str) -> None:
+        """Runs before every wrapped ``read()``: may sleep (latency/hang),
+        raise ``OSError`` (transient error) or :class:`SimulatedWorkerCrash`
+        (worker kill)."""
+        p = self.params
+        occurrence = self._occurrence(path, 'read')
+        with self._lock:
+            self._reads += 1
+            reads = self._reads
+        if self.scenario == 'worker-kill':
+            with self._lock:
+                kill = (reads >= p['kill_after_reads']
+                        and self._kills < p['max_kills'])
+                if kill:
+                    self._kills += 1
+            if kill:
+                self._count('worker_kill')
+                raise SimulatedWorkerCrash(
+                    'chaos: injected worker kill after {} reads '
+                    '(seed {})'.format(reads, self.seed))
+            return
+        draw = self._uniform(path, 'read', occurrence)
+        if self.scenario == 'transient-errors':
+            if draw < p['error_rate'] and not self._in_cooldown(path):
+                self._mark_fault(path)
+                self._count('transient_error')
+                raise OSError(errno.EIO,
+                              'chaos: injected transient read error '
+                              '(seed {}, occurrence {})'.format(
+                                  self.seed, occurrence), path)
+        elif self.scenario == 'tail-latency':
+            delay = p['base_latency_s']
+            if draw < p['tail_rate']:
+                delay = p['tail_latency_s']
+                self._count('tail_read')
+            if delay > 0:
+                time.sleep(delay)
+        elif self.scenario == 'read-hangs':
+            if draw < p['hang_rate'] and not self._in_cooldown(path):
+                self._mark_fault(path)
+                self._count('hang')
+                time.sleep(p['hang_s'])
+
+    def after_read(self, path: str, data):
+        """Runs on every wrapped ``read()``'s returned bytes: may truncate
+        (``truncated-reads``) or sleep (``fixed-latency`` — after the inner
+        read completes, matching the BENCH_r07 shim's accounting)."""
+        if self.scenario == 'fixed-latency':
+            p = self.params
+            nbytes = len(data) if data is not None else 0
+            delay = (p['seconds_per_read']
+                     + nbytes / (1024.0 * 1024.0) * p['seconds_per_mb'])
+            if delay > 0:
+                time.sleep(delay)
+            return data
+        if self.scenario != 'truncated-reads' or not data:
+            return data
+        occurrence = self._occurrence(path, 'truncate')
+        if self._uniform(path, 'truncate', occurrence) \
+                < self.params['truncate_rate'] \
+                and not self._in_cooldown(path):
+            self._mark_fault(path)
+            self._count('truncated_read')
+            return data[:max(1, len(data) // 2)]
+        return data
+
+    # -- cache-side hook -------------------------------------------------------
+
+    def cache_put_fault(self, key: str) -> None:
+        """Consulted by the shared cache before publishing a segment: raises
+        ``OSError(ENOSPC)`` under the ``cache-enospc`` scenario (the cache's
+        degrade path serves the decoded value anyway)."""
+        if self.scenario != 'cache-enospc':
+            return
+        occurrence = self._occurrence(key, 'cache_put')
+        if self._uniform(key, 'cache_put', occurrence) \
+                < self.params['enospc_rate']:
+            self._count('cache_enospc')
+            raise OSError(errno.ENOSPC,
+                          'chaos: injected ENOSPC on cache segment publish '
+                          '(seed {})'.format(self.seed), key)
+
+
+class FaultyFile:
+    """File wrapper routing every ``read()`` through the injector (and
+    counting reads/bytes on the owning filesystem, replacing the BENCH_r07
+    shim's accounting)."""
+
+    def __init__(self, inner, owner: 'FaultyFilesystem', path: str):
+        self._inner = inner
+        self._owner = owner
+        self._path = path
+
+    def read(self, *args, **kwargs):
+        self._owner.injector.before_read(self._path)
+        data = self._inner.read(*args, **kwargs)
+        self._owner.on_read(len(data) if data is not None else 0)
+        return self._owner.injector.after_read(self._path, data)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._inner.close()
+
+
+class FaultyFilesystem:
+    """fsspec-filesystem wrapper whose opened files consult a
+    :class:`FaultInjector` on every ``read()``. Thread-safe (the worker
+    thread and the readahead thread fault independently, exactly like two
+    in-flight remote range requests)."""
+
+    def __init__(self, inner, injector: FaultInjector):
+        self._inner = inner
+        self.injector = injector
+        self._lock = threading.Lock()
+        self.read_calls = 0
+        self.bytes_read = 0
+
+    def on_read(self, nbytes: int) -> None:
+        with self._lock:
+            self.read_calls += 1
+            self.bytes_read += nbytes
+
+    def open(self, path, mode='rb', **kwargs):
+        return FaultyFile(self._inner.open(path, mode, **kwargs), self, path)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+# -- the PETASTORM_TPU_CHAOS harness hook -------------------------------------
+
+#: Scenarios injecting at the filesystem layer (everything except the
+#: cache-publication fault, which arms inside the shared cache instead).
+_FS_SCENARIOS = frozenset({'transient-errors', 'tail-latency', 'read-hangs',
+                           'truncated-reads', 'worker-kill',
+                           'fixed-latency'})
+
+_env_cache_lock = threading.Lock()
+_env_cache: Dict[str, Optional[FaultInjector]] = {}
+
+
+def parse_chaos(value: str) -> Optional[FaultInjector]:
+    """``'<scenario>[:<seed>[:k=v,k=v]]'`` -> injector (``None`` for
+    empty/'none'); e.g. ``'tail-latency:7:tail_rate=0.1,tail_latency_s=0.05'``.
+    Raises on an unknown scenario or param name — a typo'd chaos spec
+    silently running a CLEAN pass would be the worst possible failure mode
+    for a chaos harness."""
+    value = (value or '').strip()
+    if not value or value == 'none':
+        return None
+    parts = value.split(':', 2)
+    scenario = parts[0]
+    seed = int(parts[1]) if len(parts) > 1 and parts[1] else 0
+    overrides = {}
+    if len(parts) > 2 and parts[2]:
+        for pair in parts[2].split(','):
+            key, _, raw = pair.partition('=')
+            try:
+                overrides[key.strip()] = int(raw)
+            except ValueError:
+                overrides[key.strip()] = float(raw)
+    return FaultInjector(scenario, seed=seed, **overrides)
+
+
+def reset_chaos_cache() -> None:
+    """Drop the per-process injector cache so the NEXT armed run starts a
+    fresh, replayable fault sequence (tests and benchmarks that run several
+    chaos passes in one process call this between passes; production
+    processes live one scenario for their lifetime)."""
+    with _env_cache_lock:
+        _env_cache.clear()
+
+
+def chaos_from_env() -> Optional[FaultInjector]:
+    """The process-wide injector configured by :data:`CHAOS_ENV_VAR`
+    (``None`` when unset). One injector per (process, env value): the worker
+    thread, readahead thread and shared cache of one interpreter share a
+    fault sequence, keeping a run replayable."""
+    value = os.environ.get(CHAOS_ENV_VAR, '').strip()
+    if not value or value == 'none':
+        return None
+    with _env_cache_lock:
+        injector = _env_cache.get(value)
+        if injector is None:
+            injector = parse_chaos(value)
+            _env_cache[value] = injector
+    return injector
+
+
+def maybe_wrap(filesystem):
+    """Wrap ``filesystem`` in the env-configured chaos scenario when one is
+    armed and injects at the fs layer; pass through otherwise. Called by
+    ``ParquetPieceWorker`` so chaos covers exactly the worker read path
+    (spawned worker interpreters inherit the env var and wrap themselves)."""
+    injector = chaos_from_env()
+    if injector is None or injector.scenario not in _FS_SCENARIOS:
+        return filesystem
+    logger.warning('chaos armed: wrapping filesystem in scenario %r '
+                   '(seed %d)', injector.scenario, injector.seed)
+    return FaultyFilesystem(filesystem, injector)
+
+
+def maybe_inject_cache_fault(key: str) -> None:
+    """Shared-cache publication hook: raises ``OSError(ENOSPC)`` when the
+    ``cache-enospc`` scenario is armed (no-op otherwise)."""
+    injector = chaos_from_env()
+    if injector is not None:
+        injector.cache_put_fault(key)

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import functools
 import logging
-import time
 from typing import Dict, Optional, Tuple
 from urllib.parse import urlparse
 
@@ -147,30 +146,31 @@ def get_dataset_path(url: str) -> str:
     return _parse_url(url)[1]
 
 
-def retry_filesystem_call(func=None, *, attempts: int = 3, initial_delay_s: float = 0.1):
-    """Retry transient filesystem errors with exponential backoff.
+def retry_filesystem_call(func=None, *, attempts: int = 3,
+                          initial_delay_s: float = 0.1,
+                          total_budget_s: Optional[float] = 30.0):
+    """Retry transient filesystem errors through the shared
+    :class:`petastorm_tpu.resilience.RetryPolicy`.
 
     TPU-native stand-in for the reference's HDFS namenode failover decorator
     (``hdfs/namenode.py:146-186``): remote object stores (GCS/S3) fail
     transiently rather than failing over, so retry-with-backoff is the
     equivalent robustness mechanism.
+
+    Two behaviors the old ad-hoc loop lacked (see ``docs/robustness.md``):
+    **permanent errors fail in one attempt** — a ``FileNotFoundError`` /
+    ``PermissionError`` / ``IsADirectoryError`` describes the request, not
+    the store, and used to burn 3 attempts with delays on a typo'd path —
+    and backoff is **full-jitter** with a total-wall cap, so many readers
+    hitting one flaky store cannot synchronize into retry storms.
     """
-    if attempts < 1:
-        raise ValueError('attempts must be >= 1, got {}'.format(attempts))
+    from petastorm_tpu.resilience import RetryPolicy
+    policy = RetryPolicy(attempts=attempts, initial_backoff_s=initial_delay_s,
+                         total_budget_s=total_budget_s)
 
     def decorate(f):
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
-            delay = initial_delay_s
-            for attempt in range(attempts):
-                try:
-                    return f(*args, **kwargs)
-                except (OSError, IOError) as e:
-                    if attempt == attempts - 1:
-                        raise
-                    logger.warning('Filesystem call %s failed (%s); retrying in %.2fs',
-                                   f.__name__, e, delay)
-                    time.sleep(delay)
-                    delay *= 2
+            return policy.call(f, *args, description=f.__name__, **kwargs)
         return wrapper
     return decorate(func) if func is not None else decorate

@@ -123,22 +123,55 @@ _NON_FAILOVER_ERRORS = (FileNotFoundError, PermissionError, IsADirectoryError,
                         NotADirectoryError, FileExistsError)
 
 
+#: Failover backoff/budget (shared RetryPolicy shape; see
+#: ``docs/robustness.md``). Jittered backoff between reconnect attempts
+#: keeps a fleet of readers from hammering a recovering namenode in
+#: lockstep; the wall budget bounds how long one call can chase failovers.
+FAILOVER_BACKOFF_S = 0.1
+FAILOVER_TOTAL_BUDGET_S = 60.0
+
+
+def _failover_classify(exc: BaseException) -> str:
+    """Failover classification: request-shaped errors surface immediately;
+    connection-shaped ``OSError``/``IOError`` rotate namenodes."""
+    from petastorm_tpu import resilience
+    if isinstance(exc, _NON_FAILOVER_ERRORS):
+        return resilience.PERMANENT
+    if isinstance(exc, (IOError, OSError)):
+        return resilience.TRANSIENT
+    return resilience.PERMANENT
+
+
 def namenode_failover(func):
     """Retry a filesystem method across namenodes on connection errors
-    (reference ``namenode_failover`` decorator, :146-186)."""
+    (reference ``namenode_failover`` decorator, :146-186), driven by the
+    shared :class:`petastorm_tpu.resilience.RetryPolicy` — which adds the
+    full-jitter backoff between reconnects and the total-wall cap the old
+    fixed loop lacked (many readers failing over together must decorrelate,
+    not storm the surviving namenode)."""
     @functools.wraps(func)
     def wrapper(self, *args, **kwargs):
+        from petastorm_tpu.resilience import RetryPolicy
         failures = []
-        for _ in range(MAX_FAILOVER_ATTEMPTS + 1):
-            try:
-                return func(self, *args, **kwargs)
-            except _NON_FAILOVER_ERRORS:
-                raise
-            except (IOError, OSError) as e:
-                failures.append(e)
-                self._try_next_namenode()
-        raise MaxFailoversExceeded(failures, MAX_FAILOVER_ATTEMPTS,
-                                   getattr(func, '__name__', str(func)))
+
+        def rotate(exc, _attempt):
+            failures.append(exc)
+            self._try_next_namenode()
+
+        policy = RetryPolicy(attempts=MAX_FAILOVER_ATTEMPTS + 1,
+                             initial_backoff_s=FAILOVER_BACKOFF_S,
+                             total_budget_s=FAILOVER_TOTAL_BUDGET_S,
+                             classify=_failover_classify)
+        try:
+            return policy.call(func, self, *args, on_retry=rotate,
+                               description=getattr(func, '__name__',
+                                                   str(func)), **kwargs)
+        except _NON_FAILOVER_ERRORS:
+            raise
+        except (IOError, OSError) as e:
+            failures.append(e)
+            raise MaxFailoversExceeded(failures, MAX_FAILOVER_ATTEMPTS,
+                                       getattr(func, '__name__', str(func)))
     return wrapper
 
 

@@ -259,6 +259,33 @@ def bottleneck_signals(snapshot: dict) -> dict:
             'queue_wait_p99_s': qw_p99, 'tail_stall': tail_stall}
 
 
+def degradation_causes(snapshot: dict) -> List[str]:
+    """Named fault-plane degradations evident in a stats snapshot — the
+    pipeline is delivering correct data, but something it normally relies
+    on has failed and been routed around (``docs/robustness.md``). Plain
+    retries/hedges are NOT causes: they are the fault plane doing its job
+    within budget."""
+    causes = []
+    n = snapshot.get('shared_put_failures', 0)
+    if n:
+        causes.append('cache-degraded: {} shared-cache segment '
+                      'publication(s) failed (ENOSPC/serialization); '
+                      'serving direct decode'.format(n))
+    n = snapshot.get('worker_respawns', 0)
+    if n:
+        causes.append('worker-respawns: {} crashed worker(s) replaced; '
+                      'in-flight items re-ventilated exactly once'.format(n))
+    n = snapshot.get('poison_items_quarantined', 0)
+    if n:
+        causes.append('poison-items: {} item(s) quarantined after '
+                      'repeatedly killing workers'.format(n))
+    n = snapshot.get('io_permanent_failures', 0)
+    if n:
+        causes.append('io-permanent-failures: {} read(s) failed with '
+                      'non-retryable errors'.format(n))
+    return causes
+
+
 def classify_pipeline(heartbeats: Dict[str, dict],
                       snapshot: Optional[dict] = None,
                       stall_after_s: float = DEFAULT_STALL_AFTER_S) -> dict:
@@ -269,7 +296,11 @@ def classify_pipeline(heartbeats: Dict[str, dict],
       for longer than ``stall_after_s`` without progress; the verdict names
       every such entity and its stage.
     - ``degraded`` — no entity over the threshold, but at least one active
-      entity is past half of it (the early warning the watchdog logs).
+      entity is past half of it (the early warning the watchdog logs) — OR
+      the fault plane routed around a failure (:func:`degradation_causes`:
+      cache ENOSPC fell through to direct decode, a crashed worker was
+      respawned, a poison item was quarantined, reads hit permanent
+      errors); the named causes ride out as ``degraded_causes``.
     - ``starving`` — entities are healthy but the io bottleneck signal fires
       with an empty result queue: storage cannot feed the consumer (the
       device is starving, not the pipeline wedged).
@@ -325,6 +356,13 @@ def classify_pipeline(heartbeats: Dict[str, dict],
                                'result queue empty): ' + signals['hint'])
         else:
             verdict['hint'] = signals['hint']
+        causes = degradation_causes(snapshot)
+        if causes:
+            verdict['degraded_causes'] = causes
+            if verdict['state'] == HEALTHY:
+                verdict['state'] = DEGRADED
+                verdict['hint'] = ('fault plane routed around a failure: '
+                                   + '; '.join(causes))
     return verdict
 
 

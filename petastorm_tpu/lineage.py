@@ -354,6 +354,28 @@ class LineageTracker:
             records = list(self._quarantines)
         return records[-limit:] if limit else records
 
+    def delivery_deficit(self, epoch: int, piece_index: int,
+                         partition: tuple) -> Optional[int]:
+        """Ventilated-minus-accounted count for one item key in one epoch —
+        the pools' **exactly-once redispatch guard**: after a worker crash,
+        an outstanding item whose deficit is already ``<= 0`` was delivered
+        (or quarantined) before the accounting message died with the worker,
+        and must NOT be re-ventilated (that is the dup the auditor would
+        catch). ``None`` when lineage is off or the epoch is unknown —
+        callers then redispatch unconditionally (at-least-once degrade,
+        documented in ``docs/robustness.md``)."""
+        if not self.enabled or piece_index is None:
+            return None
+        key = (int(piece_index), tuple(partition or (0, 1)))
+        with self._lock:
+            entry = self._epochs.get(int(epoch))
+            if entry is None:
+                return None
+            accounted = len(entry['delivered'].get(key, ()))
+            if entry['quarantined'].get(key):
+                accounted += 1
+            return entry['ventilated'].get(key, 0) - accounted
+
     def start_pass(self) -> None:
         """Mark a ``Reader.reset()`` boundary. Epoch numbers are globally
         monotone across passes (the ventilator never rewinds its epoch
@@ -718,6 +740,34 @@ def make_quarantine_record(piece, piece_index: int, epoch: int,
     return record
 
 
+def crash_quarantine_record(tracker: LineageTracker, piece_index: int,
+                            epoch: int, partition: tuple,
+                            crash_count: int) -> dict:
+    """Quarantine record for a **poison item** — one that killed its worker
+    ``crash_count`` times through the pool supervisor's bounded respawns.
+    The record rides the normal lineage quarantine channel, so the coverage
+    audit reads the item as *quarantined* (accounted for), never as a silent
+    drop — and the pipeline moves on instead of crash-looping
+    (``docs/robustness.md``)."""
+    import types
+    info = tracker.pieces.get(int(piece_index)) if piece_index is not None \
+        else None
+    path, row_group, num_rows = info if info else ('<unknown>', -1, -1)
+    partition = tuple(partition or (0, 1))
+    k, n = int(partition[0]), max(1, int(partition[1]))
+    rows = num_rows if num_rows and num_rows > 0 else 1
+    if n > 1 and num_rows and num_rows > 0:
+        # the np.array_split contract the drop-partition slicing follows:
+        # the first (num_rows % n) partitions carry one extra row
+        rows = num_rows // n + (1 if k < num_rows % n else 0)
+    piece = types.SimpleNamespace(path=path, row_group=row_group)
+    return make_quarantine_record(
+        piece, int(piece_index if piece_index is not None else -1),
+        int(epoch or 0), partition, tracker.shard, 'worker-crash',
+        RuntimeError('item killed {} worker(s); quarantined instead of '
+                     'crash-looping'.format(crash_count)), rows=rows)
+
+
 # -- replay -------------------------------------------------------------------
 
 class _ReplayCollector:
@@ -770,7 +820,7 @@ def replay_records(reader, records: List[Provenance],
         raise RuntimeError('reader does not expose replay machinery')
     args = dict(worker_args)
     args.update(trace=False, health=False, lineage=False, latency=False,
-                io_readahead=0)
+                io_readahead=0, hedge=False)
     collector = _ReplayCollector()
     worker = worker_class(-1, collector, args)
     pieces_out = []

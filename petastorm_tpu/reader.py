@@ -114,13 +114,16 @@ def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_est
 
 
 def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer,
-               zmq_copy_buffers, profiling_enabled=False, tracer=None):
+               zmq_copy_buffers, profiling_enabled=False, tracer=None,
+               recovery=None):
     if reader_pool_type == 'thread':
         return ThreadPool(workers_count, results_queue_size,
-                          profiling_enabled=profiling_enabled, tracer=tracer)
+                          profiling_enabled=profiling_enabled, tracer=tracer,
+                          recovery=recovery)
     if reader_pool_type == 'process':
         return ProcessPool(workers_count, serializer=serializer,
-                           zmq_copy_buffers=zmq_copy_buffers, tracer=tracer)
+                           zmq_copy_buffers=zmq_copy_buffers, tracer=tracer,
+                           recovery=recovery)
     if reader_pool_type == 'dummy':
         return DummyPool(tracer=tracer)
     raise ValueError("reader_pool_type must be one of 'thread', 'process', 'dummy'; "
@@ -183,7 +186,8 @@ def make_reader(dataset_url,
                 io_readahead=0, trace=None, metrics_interval=0,
                 metrics_out=None, debug_port=None, stall_timeout=0,
                 flight_record_dir=None, on_decode_error='raise',
-                slo=None, autotune=False):
+                slo=None, autotune=False, retry=None, hedge=None,
+                worker_recovery=None):
     """Row-granular reader for petastorm_tpu datasets (codec-decoded rows).
 
     Mirrors the reference factory (``reader.py:61-195``). Raises a helpful error
@@ -241,6 +245,17 @@ def make_reader(dataset_url,
     configuration, with hysteresis, per-knob cooldowns and
     revert-on-regression. Every action is observable via ``/autotune``,
     flight records and ``/metrics``. See ``docs/autotune.md``.
+
+    Fault tolerance (``docs/robustness.md``): ``retry=`` (default ON)
+    retries transient storage errors under the shared
+    :class:`~petastorm_tpu.resilience.RetryPolicy` (full-jitter backoff,
+    total-wall cap; permanent errors fail in one attempt); ``hedge=``
+    (default off; ``True``, a threshold in seconds, or an options dict)
+    fires a duplicate row-group read when the first exceeds the live p95 —
+    first result wins; ``worker_recovery=`` (default ON) respawns a crashed
+    worker and re-ventilates its in-flight items exactly once, with bounded
+    respawns and poison-item quarantine. ``PETASTORM_TPU_CHAOS`` arms the
+    deterministic fault-injection harness.
     """
     dataset_url = normalize_dataset_url_or_urls(dataset_url)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url, storage_options)
@@ -257,11 +272,12 @@ def make_reader(dataset_url,
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings)
     tracer, trace_export = _make_tracer(trace)
+    from petastorm_tpu.resilience import resolve_recovery
     # ZeroCopySerializer: decoded ndarray payloads cross the process boundary
     # as out-of-band ZMQ frames instead of being memcpy'd into a pickle blob
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
                       ZeroCopySerializer(), zmq_copy_buffers, profiling_enabled,
-                      tracer=tracer)
+                      tracer=tracer, recovery=resolve_recovery(worker_recovery))
     cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
     return Reader(factory, path,
                   worker_class=RowGroupWorker,
@@ -278,7 +294,7 @@ def make_reader(dataset_url,
                   debug_port=debug_port, stall_timeout=stall_timeout,
                   flight_record_dir=flight_record_dir,
                   on_decode_error=on_decode_error, slo=slo,
-                  autotune=autotune)
+                  autotune=autotune, retry=retry, hedge=hedge)
 
 
 def make_columnar_reader(dataset_url,
@@ -298,7 +314,8 @@ def make_columnar_reader(dataset_url,
                          io_readahead=0, trace=None, metrics_interval=0,
                          metrics_out=None, debug_port=None, stall_timeout=0,
                          flight_record_dir=None, on_decode_error='raise',
-                         slo=None, autotune=False):
+                         slo=None, autotune=False, retry=None, hedge=None,
+                         worker_recovery=None):
     """Vectorized codec-decoded reader for petastorm_tpu datasets.
 
     Yields **batch namedtuples of decoded numpy column arrays** (one per row
@@ -332,9 +349,10 @@ def make_columnar_reader(dataset_url,
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings)
     tracer, trace_export = _make_tracer(trace)
+    from petastorm_tpu.resilience import resolve_recovery
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
                       ZeroCopySerializer(), zmq_copy_buffers, profiling_enabled,
-                      tracer=tracer)
+                      tracer=tracer, recovery=resolve_recovery(worker_recovery))
     cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
     return Reader(factory, path,
                   worker_class=ColumnarWorker,
@@ -351,7 +369,7 @@ def make_columnar_reader(dataset_url,
                   debug_port=debug_port, stall_timeout=stall_timeout,
                   flight_record_dir=flight_record_dir,
                   on_decode_error=on_decode_error, slo=slo,
-                  autotune=autotune)
+                  autotune=autotune, retry=retry, hedge=hedge)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -368,7 +386,8 @@ def make_batch_reader(dataset_url_or_urls,
                       profiling_enabled=False, io_readahead=0, trace=None,
                       metrics_interval=0, metrics_out=None, debug_port=None,
                       stall_timeout=0, flight_record_dir=None,
-                      on_decode_error='raise', slo=None, autotune=False):
+                      on_decode_error='raise', slo=None, autotune=False,
+                      retry=None, hedge=None, worker_recovery=None):
     """Vectorized batch reader for arbitrary parquet stores
     (reference ``reader.py:198-327``). Yields namedtuples of column arrays,
     one per row group. ``io_readahead`` prefetches upcoming row-group reads
@@ -387,9 +406,10 @@ def make_batch_reader(dataset_url_or_urls,
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings)
     tracer, trace_export = _make_tracer(trace)
+    from petastorm_tpu.resilience import resolve_recovery
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
                       ArrowTableSerializer(), zmq_copy_buffers, profiling_enabled,
-                      tracer=tracer)
+                      tracer=tracer, recovery=resolve_recovery(worker_recovery))
     cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
     return Reader(factory, path,
                   worker_class=ArrowBatchWorker,
@@ -405,7 +425,7 @@ def make_batch_reader(dataset_url_or_urls,
                   stall_timeout=stall_timeout,
                   flight_record_dir=flight_record_dir,
                   on_decode_error=on_decode_error, slo=slo,
-                  autotune=autotune)
+                  autotune=autotune, retry=retry, hedge=hedge)
 
 
 class Reader:
@@ -421,7 +441,7 @@ class Reader:
                  io_readahead=0, trace_export=None, metrics_interval=0,
                  metrics_out=None, debug_port=None, stall_timeout=0,
                  flight_record_dir=None, on_decode_error='raise',
-                 slo=None, autotune=False):
+                 slo=None, autotune=False, retry=None, hedge=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -437,6 +457,12 @@ class Reader:
             raise ValueError('stall_timeout must be >= 0, got '
                              '{!r}'.format(stall_timeout))
         validate_decode_error_policy(on_decode_error)
+        # resolve + validate the resilience knobs here (fail fast on a
+        # typo'd option); workers re-resolve the stored shapes after
+        # unpickling (docs/robustness.md)
+        from petastorm_tpu.resilience import resolve_hedge, resolve_retry
+        retry_options = resolve_retry(retry)
+        hedge_options = resolve_hedge(hedge)
         if slo:
             # fail fast on a typo'd target name; the monitor itself is
             # built after the pool (it reads the stats snapshot + latency)
@@ -658,6 +684,10 @@ class Reader:
             'lineage': self.lineage.enabled,
             'latency': getattr(pool.stats, 'latency', None) is not None,
             'readahead_controlled': autotune_active,
+            # resolved dicts, or False for explicitly-off (a missing key
+            # means "default" to the worker, which is not the same thing)
+            'retry': retry_options if retry_options else False,
+            'hedge': hedge_options if hedge_options else False,
             'on_decode_error': on_decode_error,
             'shard': cur_shard if cur_shard is not None else -1,
             'filesystem_factory': filesystem_factory,

@@ -95,6 +95,15 @@ class FileHandleCache:
             old.close()
         return handle
 
+    def invalidate(self, path: str) -> None:
+        """Close and drop the cached handle for ``path`` (retry hygiene: a
+        handle that just failed mid-read may be stuck mid-stream — the next
+        attempt must reopen, not resume a poisoned position)."""
+        with self._lock:
+            handle = self._entries.pop(path, None)
+        if handle is not None:
+            handle.close()
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
@@ -115,7 +124,19 @@ class ParquetPieceWorker(WorkerBase):
 
     def __init__(self, worker_id, publish_func, args):
         super().__init__(worker_id, publish_func, args)
-        self._filesystem = args['filesystem_factory']()
+        from petastorm_tpu import faultfs
+        from petastorm_tpu.resilience import (ResilientIO, resolve_hedge,
+                                              resolve_retry)
+        # chaos harness (docs/robustness.md): when PETASTORM_TPU_CHAOS is
+        # armed, the worker's filesystem — and ONLY the worker's; reader
+        # construction stays clean — wraps in the scenario's fault injector.
+        # Spawned process workers inherit the env var and wrap themselves.
+        self._filesystem = faultfs.maybe_wrap(args['filesystem_factory']())
+        # -- resilient IO (retry + hedge; see petastorm_tpu/resilience.py) -----
+        retry_options = resolve_retry(args.get('retry', True))
+        hedge_options = resolve_hedge(args.get('hedge', False))
+        self._resilience = (ResilientIO(retry_options, hedge_options)
+                            if retry_options or hedge_options else None)
         self._dataset_path = args['dataset_path']
         self._schema = args['schema']                  # output view
         self._full_schema = args['full_schema']
@@ -203,6 +224,8 @@ class ParquetPieceWorker(WorkerBase):
             self._readahead.set_depth(depth)
 
     def shutdown(self):
+        if self._resilience is not None:
+            self._resilience.drain()
         if self._readahead is not None:
             self._readahead.stop()
         if self._prefetch_files is not None:
@@ -302,9 +325,22 @@ class ParquetPieceWorker(WorkerBase):
 
     def _readahead_read(self, piece, columns: List[str]):
         """The background thread's read path — its own file handles, no shared
-        state with the worker thread."""
-        return self._prefetch_files.get(piece.path).read_row_group(
-            piece.row_group, columns=columns)
+        state with the worker thread. Retried under the shared policy (a
+        transient storage error must not surface as a failed prefetch the
+        worker re-raises); hedging stays on the synchronous path only — the
+        background read is already asynchronous to the worker."""
+        def read():
+            return self._prefetch_files.get(piece.path).read_row_group(
+                piece.row_group, columns=columns)
+        if self._resilience is None or self._resilience.retry is None:
+            return read()
+
+        def reopen(_exc, _attempt):
+            self._prefetch_files.invalidate(piece.path)
+        return self._resilience.retry.call(
+            read, on_retry=reopen, on_event=self._resilience._count,
+            description='readahead_read({}:{})'.format(piece.path,
+                                                       piece.row_group))
 
     # -- reads -----------------------------------------------------------------
 
@@ -321,15 +357,64 @@ class ParquetPieceWorker(WorkerBase):
             table = self._readahead.take(self._read_key(piece, columns))
             self._readahead.drain_stats_into(self)
             if table is not None:
+                self._drain_resilience_events()
                 return table
         start = time.perf_counter()
-        table = self._parquet_file(piece.path).read_row_group(
-            piece.row_group, columns=columns)
+        table = self._resilient_read(piece, columns)
         elapsed = time.perf_counter() - start
         self.record_time('worker_io_s', elapsed)
         self.record_span('parquet_read', 'io', start, elapsed,
                          args={'row_group': piece.row_group})
+        self._drain_resilience_events()
         return table
+
+    def _resilient_read(self, piece, columns: List[str]):
+        """One physical row-group read under the configured hedge (inner)
+        and retry (outer) layers (``docs/robustness.md``).
+
+        With hedging ON, every attempt opens a **fresh** parquet handle on
+        its own thread: a losing read keeps running until its blocking call
+        returns, and a ``pq.ParquetFile`` must never serve two concurrent
+        reads — so the abandoned loser may not share the worker's handle
+        cache. The open-per-read cost is the documented price of hedging
+        (it targets remote tail-latency stores, where open is cheap next to
+        the tail). Retry-only readers keep the cached handle and invalidate
+        it before each retry."""
+        resilience = self._resilience
+        if resilience is None or not resilience.enabled:
+            return self._parquet_file(piece.path).read_row_group(
+                piece.row_group, columns=columns)
+        description = 'read_row_group({}:{})'.format(piece.path,
+                                                     piece.row_group)
+        if resilience.hedge is not None:
+            def fresh_read():
+                handle = self._open_parquet(piece.path)
+                try:
+                    return handle.read_row_group(piece.row_group,
+                                                 columns=columns)
+                finally:
+                    handle.close()
+            return resilience.read(fresh_read, description=description)
+
+        def cached_read():
+            return self._parquet_file(piece.path).read_row_group(
+                piece.row_group, columns=columns)
+
+        def reopen(_exc, _attempt):
+            self._open_files.invalidate(piece.path)
+        return resilience.read(cached_read, on_retry=reopen,
+                               description=description)
+
+    def _drain_resilience_events(self) -> None:
+        """Transfer retry/hedge counters into the worker's stats (worker
+        thread only — the hedge helper threads and the readahead thread
+        accumulate into the resilience object's own lock-protected dict,
+        exactly like the readahead stats drain)."""
+        if self._resilience is None:
+            return
+        for name, n in self._resilience.take_events().items():
+            if n:
+                self.record_count(name, n)
 
     def _decode_table(self, table, names,
                       error_sink: Optional[DecodeErrorSink] = None) -> Dict:

@@ -571,10 +571,10 @@ class SharedRowGroupCache(CacheBase):
         os.makedirs(self._counters_dir, exist_ok=True)
         self._attached: 'OrderedDict[str, _Attachment]' = OrderedDict()
         self._events = {'shared_hits': 0, 'shared_misses': 0,
-                        'shared_evictions': 0}
+                        'shared_evictions': 0, 'shared_put_failures': 0}
         self._totals = {'hits': 0, 'misses': 0, 'fills': 0, 'evictions': 0,
                         'spills': 0, 'corrupt_dropped': 0, 'lock_waits': 0,
-                        'lock_steals': 0}
+                        'lock_steals': 0, 'put_failures': 0}
         self._events_since_flush = 0
         self._counter_path = os.path.join(
             self._counters_dir,
@@ -897,15 +897,27 @@ class SharedRowGroupCache(CacheBase):
             value = fill_cache_func()
             self._record(hit=False)
             try:
+                # chaos hook (docs/robustness.md): the cache-enospc scenario
+                # raises here, exercising the same degrade path a genuinely
+                # full /dev/shm or spill disk takes
+                from petastorm_tpu.faultfs import maybe_inject_cache_fault
+                maybe_inject_cache_fault(digest)
                 kind, frames = _serialize_payload(value)
                 self._mem.put(digest, kind, frames)
                 with self._lock:
                     self._totals['fills'] += 1
             except (OSError, pickle.PicklingError, TypeError,
                     ValueError) as e:
-                # cache publication failures must never fail the read path
-                logger.warning('failed to publish shared-cache segment: %s',
-                               e)
+                # cache publication failures must never fail the read path:
+                # the freshly decoded value is served directly, the event is
+                # counted (shared_put_failures -> ReaderStats -> a named
+                # 'degraded' cause in /healthz), and the pipeline runs on
+                # without the cache tier
+                logger.warning('failed to publish shared-cache segment '
+                               '(degrading to direct decode): %s', e)
+                with self._lock:
+                    self._events['shared_put_failures'] += 1
+                    self._totals['put_failures'] += 1
             return value
         finally:
             if got_lock:

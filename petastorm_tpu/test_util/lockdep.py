@@ -56,6 +56,8 @@ DEFAULT_TARGET_MODULES = (
     'petastorm_tpu.lineage',
     'petastorm_tpu.latency',
     'petastorm_tpu.autotune',
+    'petastorm_tpu.resilience',
+    'petastorm_tpu.faultfs',
     'petastorm_tpu.workers.thread_pool',
     'petastorm_tpu.workers.stats',
     'petastorm_tpu.workers.ventilator',

@@ -16,8 +16,13 @@ class VentilatedItemProcessedMessage:
     (reference ``thread_pool.py:155-176``). ``stats`` optionally carries the
     item's per-stage wall times (``{stage: seconds}``) plus transport counters
     back across the process boundary; the pool merges it into ``pool.stats``.
+    ``seq`` is the pool-assigned ventilation sequence number of the item
+    (process pools; ``None`` elsewhere) — it retires the item from the
+    pool's outstanding ledger, which is what worker auto-recovery consults
+    to know exactly which in-flight items died with a crashed worker.
     """
-    __slots__ = ('stats',)
+    __slots__ = ('stats', 'seq')
 
-    def __init__(self, stats=None):
+    def __init__(self, stats=None, seq=None):
         self.stats = stats
+        self.seq = seq

@@ -118,10 +118,32 @@ class ProcessPool:
     supports_prefetch_hints = True
 
     def __init__(self, workers_count: int, serializer=None, zmq_copy_buffers: bool = True,
-                 tracer=None):
+                 tracer=None, recovery=None):
         self._workers_count = workers_count
         self._serializer = as_multipart(serializer or PickleSerializer())
         self._zmq_copy_buffers = zmq_copy_buffers
+        #: Worker auto-recovery options (``resilience.resolve_recovery``
+        #: shape) or ``None`` — with recovery on, a crashed worker is
+        #: respawned through the saved bootstrap and its in-flight items are
+        #: re-ventilated exactly once (docs/robustness.md); with it off, a
+        #: death stops the pool loudly (the pre-recovery behavior).
+        self._recovery = recovery
+        #: seq -> (args, kwargs) of every ventilated-but-unaccounted item —
+        #: what recovery consults to know which items died with a worker.
+        self._outstanding = {}
+        self._next_item_seq = 0
+        self._respawns_used = 0
+        #: item key -> number of worker deaths the item was in flight for
+        #: (the poison-item detector; see ``_finalize_recovery``).
+        self._crash_counts = {}
+        #: Live recovery episode state (None when not recovering).
+        self._recovering = None
+        # serializes concurrent _spawn_worker list mutations (a controller
+        # resize racing a consumer-thread recovery respawn)
+        self._spawn_mutex = threading.Lock()
+        # refined from worker_args at start()
+        self._hb_enabled = True
+        self._hb_interval = _HEARTBEAT_INTERVAL_S
         #: Optional :class:`petastorm_tpu.tracing.Tracer`. Worker processes
         #: record spans locally and ship batches back inside the per-item
         #: accounting message (same pattern as the stage times); the pool
@@ -190,6 +212,12 @@ class ProcessPool:
                             '{}:{}'.format(_LOCALHOST, work_port),
                             '{}:{}'.format(_LOCALHOST, control_port),
                             '{}:{}'.format(_LOCALHOST, results_port))
+        # recovery's settle proof (see _maybe_finalize_recovery) needs the
+        # worker heartbeat cadence and whether heartbeats flow at all
+        args_dict = worker_args if isinstance(worker_args, dict) else {}
+        self._hb_enabled = args_dict.get('health') is not False
+        self._hb_interval = float(args_dict.get('heartbeat_interval_s',
+                                                _HEARTBEAT_INTERVAL_S))
         for worker_id in range(self._workers_count):
             self._spawn_worker(worker_id)
 
@@ -232,10 +260,17 @@ class ProcessPool:
             _worker_bootstrap,
             args=(worker_class, worker_id, worker_args, self._serializer,
                   work_addr, control_addr, results_addr, os.getpid()))
-        # copy-on-write rebind: readers (_check_workers_alive on the
-        # consumer thread) iterate whatever list object they grabbed
-        self._processes = self._processes + [proc]
-        self._procs_by_worker_id[worker_id] = proc
+        with self._spawn_mutex:
+            # copy-on-write rebind: readers (_check_workers_alive on the
+            # consumer thread) iterate whatever list object they grabbed
+            self._processes = self._processes + [proc]
+            self._procs_by_worker_id[worker_id] = proc
+
+    def _allocate_worker_id(self) -> int:
+        with self._accounting_lock:
+            worker_id = self._next_worker_id
+            self._next_worker_id += 1
+        return worker_id
 
     # -- live resize (the autotune controller's actuator; docs/autotune.md) ----
 
@@ -270,9 +305,7 @@ class ProcessPool:
             current = self._workers_count
             if workers_count > current:
                 for _ in range(workers_count - current):
-                    worker_id = self._next_worker_id
-                    self._next_worker_id += 1
-                    self._spawn_worker(worker_id)
+                    self._spawn_worker(self._allocate_worker_id())
                 with self._accounting_lock:
                     self._workers_count += workers_count - current
                 return self._workers_count
@@ -394,7 +427,10 @@ class ProcessPool:
     def ventilate(self, *args, **kwargs):
         with self._accounting_lock:
             self._ventilated_items += 1
-        self._work_sender.send_pyobj((args, kwargs))
+            seq = self._next_item_seq
+            self._next_item_seq += 1
+            self._outstanding[seq] = (args, kwargs)
+        self._work_sender.send_pyobj((seq, args, kwargs))
 
     def _all_work_consumed(self) -> bool:
         with self._accounting_lock:
@@ -422,12 +458,16 @@ class ProcessPool:
                 if self._all_work_consumed():
                     raise EmptyResultError()
                 self._check_workers_alive()
+                self._maybe_finalize_recovery()
                 continue
             payload_frames, control = self._recv_multipart()
             if isinstance(control, VentilatedItemProcessedMessage):
                 with self._accounting_lock:
                     self._processed_items += 1
                     in_flight = self._ventilated_items - self._processed_items
+                    if control.seq is not None:
+                        self._outstanding.pop(control.seq, None)
+                self._note_recovery_progress()
                 self._merge_item_stats(getattr(control, 'stats', None))
                 self.stats.gauge('queue_depth', in_flight)
                 if self._ventilator is not None:
@@ -451,11 +491,19 @@ class ProcessPool:
                 # the interpreter off the hot path on the resizing thread
                 self._on_worker_retired(control.worker_id)
                 continue
+            if isinstance(control, _WorkerStarted):
+                # a replacement worker spawned by recovery reported in:
+                # redispatch may proceed once every replacement is connected
+                recovering = self._recovering
+                if recovering is not None:
+                    recovering['awaiting_start'].discard(control.worker_id)
+                continue
             provenance = None
             if isinstance(control, tuple) and len(control) == 2 \
                     and control[0] == _DATA:
                 control, provenance = control
             if control == _DATA:
+                self._note_recovery_progress()
                 with self._accounting_lock:
                     self._results_produced += 1
                 copies_before = getattr(self._serializer, 'copies', 0)
@@ -532,10 +580,179 @@ class ProcessPool:
 
     def _check_workers_alive(self):
         dead = [p for p in self._processes if p.poll() not in (None, 0)]
-        if dead and not self._stopped:
-            codes = [p.returncode for p in dead]
-            self.stop()
-            raise RuntimeError('Worker process(es) died with exit codes {}'.format(codes))
+        if not dead or self._stopped:
+            return
+        codes = [p.returncode for p in dead]
+        recovery = self._recovery
+        if recovery is not None:
+            budget = recovery.get('max_respawns')
+            if budget is None:
+                budget = max(3, self._workers_count)
+            if self._respawns_used + len(dead) <= budget:
+                self._begin_recovery(dead, codes)
+                return
+            logger.error('worker respawn budget exhausted (%d used, %d '
+                         'dead, budget %d): stopping the pool',
+                         self._respawns_used, len(dead), budget)
+        self.stop()
+        raise RuntimeError('Worker process(es) died with exit codes {}'.format(codes))
+
+    # -- worker auto-recovery (docs/robustness.md) -----------------------------
+
+    def _begin_recovery(self, dead, codes) -> None:
+        """Consumer-thread entry of one recovery episode: replace the dead
+        interpreters through the saved bootstrap, pause the ventilator, and
+        start the settle clock. The episode finalizes (redispatch) once the
+        survivors drained and every replacement reported in — in the
+        meantime results keep flowing to the caller normally."""
+        dead_pids = {p.pid for p in dead}
+        dead_ids = [wid for wid, p in list(self._procs_by_worker_id.items())
+                    if p in dead]
+        logger.warning('worker process(es) %s died with exit codes %s; '
+                       'respawning and re-ventilating their in-flight items',
+                       dead_ids, codes)
+        with self._spawn_mutex:
+            self._processes = [p for p in self._processes if p not in dead]
+            for wid in dead_ids:
+                self._procs_by_worker_id.pop(wid, None)
+        # a dead worker's last heartbeat must not age into a false stall
+        # verdict against an entity that no longer exists
+        with self._hb_lock:
+            self._heartbeats = {
+                entity: record for entity, record in self._heartbeats.items()
+                if record.get('pid') not in dead_pids}
+        vent = self._ventilator
+        pause = getattr(vent, 'pause', None)
+        if pause is not None:
+            pause()
+        replacements = set()
+        for _ in dead:
+            worker_id = self._allocate_worker_id()
+            self._spawn_worker(worker_id)
+            replacements.add(worker_id)
+        self._respawns_used += len(dead)
+        self.stats.add('worker_respawns', len(dead))
+        now = time.monotonic()
+        if self._recovering is not None:
+            # a replacement died while an episode was still settling: fold
+            # the new spawns in and restart the settle clock
+            self._recovering['awaiting_start'] |= replacements
+            self._recovering['last_progress'] = now
+        else:
+            self._recovering = {'awaiting_start': replacements,
+                                'last_progress': now}
+
+    def _note_recovery_progress(self) -> None:
+        if self._recovering is not None:
+            self._recovering['last_progress'] = time.monotonic()
+
+    def _maybe_finalize_recovery(self) -> None:
+        """Finalize a settling recovery episode: once (a) every replacement
+        connected, (b) no item has completed for the settle window, and (c)
+        every surviving worker's heartbeat shows an idle-class stage, the
+        remaining outstanding items are exactly the ones that died with the
+        crashed worker(s).
+
+        Why (c) and the settle floor make redispatch exactly-once: a
+        survivor that starts an item beats a non-idle stage, and the pool's
+        view of that beat is at most one heartbeat interval stale — so with
+        the settle window floored at ``1.25 x heartbeat_interval_s``, an
+        item a survivor began can never look both "no progress for the
+        whole window" AND "worker idle" at once. An item a survivor still
+        holds therefore always blocks finalize, and only truly-lost items
+        are re-ventilated."""
+        recovering = self._recovering
+        if recovering is None or self._stopped:
+            return
+        if recovering['awaiting_start']:
+            return
+        settle_s = (self._recovery or {}).get('settle_s', 1.0)
+        if self._hb_enabled:
+            settle_s = max(settle_s, 1.25 * self._hb_interval)
+        if time.monotonic() - recovering['last_progress'] < settle_s:
+            return
+        if self._hb_enabled:
+            from petastorm_tpu.health import IDLE_STAGES
+            with self._hb_lock:
+                records = dict(self._heartbeats)
+            for entity, record in records.items():
+                if entity.startswith('worker-') \
+                        and record.get('stage') not in IDLE_STAGES:
+                    return   # a survivor is mid-item; keep waiting
+        self._recovering = None
+        self._finalize_recovery()
+
+    @staticmethod
+    def _item_key(seq, kwargs):
+        """Stable identity of a ventilated item across epochs (poison
+        accounting): the reader's items are kwargs dicts carrying
+        ``piece_index``/``shuffle_row_drop_partition``; anything else keys
+        by its seq (poison detection then only spans one dispatch)."""
+        piece_index = kwargs.get('piece_index')
+        if piece_index is None:
+            return ('seq', seq)
+        return (piece_index,
+                tuple(kwargs.get('shuffle_row_drop_partition') or (0, 1)))
+
+    def _synthesize_processed(self, seq) -> None:
+        """Retire an outstanding item WITHOUT redispatching it (it was
+        already delivered/quarantined): the accounting the dead worker never
+        sent is synthesized here so the epoch's counts settle."""
+        with self._accounting_lock:
+            self._processed_items += 1
+            self._outstanding.pop(seq, None)
+        if self._ventilator is not None:
+            self._ventilator.processed_item()
+
+    def _finalize_recovery(self) -> None:
+        from petastorm_tpu.lineage import crash_quarantine_record
+        with self._accounting_lock:
+            lost = sorted(self._outstanding.items())
+        poison_threshold = (self._recovery or {}).get('poison_threshold', 3)
+        tracker = self.lineage if (self.lineage is not None
+                                   and self.lineage.enabled) else None
+        plan = []
+        for seq, (args, kwargs) in lost:
+            key = self._item_key(seq, kwargs)
+            count = self._crash_counts.get(key, 0) + 1
+            self._crash_counts[key] = count
+            plan.append((count, seq, args, kwargs, key))
+        redispatched = 0
+        # repeat offenders go LAST: innocents lost in a poison item's blast
+        # radius complete before the next crash, so only the item that
+        # keeps killing workers accumulates toward the threshold
+        for count, seq, args, kwargs, key in sorted(
+                plan, key=lambda entry: (entry[0], entry[1])):
+            epoch = kwargs.get('epoch', 0)
+            piece_index = kwargs.get('piece_index')
+            partition = kwargs.get('shuffle_row_drop_partition', (0, 1))
+            deficit = (tracker.delivery_deficit(epoch, piece_index, partition)
+                       if tracker is not None else None)
+            if deficit is not None and deficit <= 0:
+                # the worker published this item's payload and died before
+                # the accounting frame: it WAS delivered — redispatching it
+                # would be the duplicate the auditor exists to catch
+                self._synthesize_processed(seq)
+                continue
+            if count >= poison_threshold:
+                logger.error('poison item %s killed %d worker(s); '
+                             'quarantining it instead of crash-looping', key,
+                             count)
+                if tracker is not None and piece_index is not None:
+                    tracker.add_quarantines([crash_quarantine_record(
+                        tracker, piece_index, epoch, partition, count)])
+                self.stats.add('poison_items_quarantined')
+                self._synthesize_processed(seq)
+                continue
+            self._work_sender.send_pyobj((seq, args, kwargs))
+            redispatched += 1
+        if redispatched:
+            self.stats.add('items_redispatched', redispatched)
+            logger.warning('re-ventilated %d in-flight item(s) lost with '
+                           'crashed worker(s)', redispatched)
+        resume = getattr(self._ventilator, 'resume', None)
+        if resume is not None:
+            resume()
 
     def stop(self):
         if self._stopped:
@@ -787,7 +1004,7 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
                         # hold, ack, exit 0 (see ProcessPool.resize)
                         retiring = True
                         break
-                    pending.append(entry)
+                    pending.append(entry)   # (seq, args, kwargs)
             if retiring and not pending:
                 # final drain: anything that slipped into our pipe behind
                 # the marker is processed, not orphaned (the quiesce makes
@@ -808,9 +1025,11 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
                 continue
             if hint is not None:
                 # whole pending FIFO, head included (the readahead treats its
-                # outstanding reads as a prefix of this list)
-                hint(list(pending))
-            args, kwargs = pending.popleft()
+                # outstanding reads as a prefix of this list); the seq tag is
+                # pool accounting, not part of the worker-facing item shape
+                hint([(h_args, h_kwargs) for _seq, h_args, h_kwargs
+                      in pending])
+            seq, args, kwargs = pending.popleft()
             if health_on and hasattr(worker, 'beat'):
                 worker.beat('processing')
             item['serialize_s'] = 0.0
@@ -874,7 +1093,8 @@ def _worker_bootstrap(worker_class, worker_id, worker_args, serializer,
                                       if hasattr(worker, 'drain_spans') else [])
                 item_spans = []
                 item_stats['spans'] = spans
-            send([b''], VentilatedItemProcessedMessage(stats=item_stats))
+            send([b''], VentilatedItemProcessedMessage(stats=item_stats,
+                                                       seq=seq))
             if health_on and publish_beat['fn'] is not None:
                 # the accounting send's back-pressure path resumes at
                 # 'processing'; between items the truthful stage is idle

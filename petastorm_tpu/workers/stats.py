@@ -58,6 +58,22 @@ COUNTERS = (
     'shared_hits',       # row groups served from the host-wide shared cache
     'shared_misses',     # shared-cache lookups that fell through to io+decode
     'shared_evictions',  # shared-cache segments evicted/spilled (this reader)
+    'shared_put_failures',  # cache segment publications that failed
+                            # (ENOSPC/serialization) and degraded to direct
+                            # decode — a named degradation cause in /healthz
+    'io_retries',        # row-group/prefetch reads re-attempted after a
+                         # transient storage error (docs/robustness.md)
+    'io_hedges',         # duplicate reads fired when the primary exceeded
+                         # the live hedge threshold
+    'io_hedge_wins',     # hedged reads where the DUPLICATE finished first
+    'io_hedge_losses',   # hedged reads where the primary still won
+    'io_permanent_failures',  # reads that failed with a non-retryable
+                              # (request-shaped) error
+    'worker_respawns',   # crashed workers replaced by the pool supervisor
+    'items_redispatched',  # in-flight items re-ventilated after a worker
+                           # crash (exactly-once: deficit-checked first)
+    'poison_items_quarantined',  # items quarantined after killing workers
+                                 # repeatedly (no crash loop)
 )
 
 #: Occupancy gauges; each also keeps a ``<name>_max`` high-water mark.

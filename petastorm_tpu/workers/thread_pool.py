@@ -124,19 +124,48 @@ class WorkerThread(threading.Thread):
                 if beat is not None:
                     beat('processing')
                 wait_before = self._publish_wait['s']
+                published_before = self._publish_wait['items']
                 start = time.perf_counter()
                 try:
                     self._worker.process(*args, **kwargs)
                 except (OSError, MemoryError) as e:
-                    # infra failure (NEVER_QUARANTINE class): ship it, then
-                    # stop serving from a broken resource — the consumer
-                    # re-raises the shipped exception and stops the pool
+                    # infra failure (NEVER_QUARANTINE class). TRANSIENT
+                    # storage errors that escaped the retry budget route to
+                    # recovery: the worker is replaced and its items
+                    # re-dispatched (docs/robustness.md). PERMANENT errors
+                    # (bad path, permissions — retrying cannot help) and
+                    # MemoryError (a respawned thread shares the same heap)
+                    # stay LOUD: recovery converting a deleted file into a
+                    # poison-item quarantine would be silent data loss.
+                    from petastorm_tpu.resilience import (TRANSIENT,
+                                                          classify_error)
+                    if (isinstance(e, OSError)
+                            and classify_error(e) == TRANSIENT
+                            and self._pool._handle_worker_crash(
+                                self, (args, kwargs), list(pending), e,
+                                self._publish_wait['items']
+                                > published_before)):
+                        return
                     self._pool._put_result(_WorkerException(e))
                     raise
                 except Exception as e:  # ship to consumer; keep serving
                     logger.debug('Worker %s raised:\n%s', self._worker.worker_id,
                                  traceback.format_exc())
                     self._pool._put_result(_WorkerException(e))
+                except BaseException as e:
+                    # a killed worker (SimulatedWorkerCrash / interpreter
+                    # shutdown): recovery replaces it; when recovery is off
+                    # or budget-exhausted the crash ships to the consumer —
+                    # a dying thread that told nobody would turn a crash
+                    # loop into a silent hang (the consumer re-raises the
+                    # shipped exception; re-raising here too would only
+                    # trip pytest's unhandled-thread-exception hook)
+                    if self._pool._handle_worker_crash(
+                            self, (args, kwargs), list(pending), e,
+                            self._publish_wait['items'] > published_before):
+                        return
+                    self._pool._put_result(_WorkerException(e))
+                    return
                 elapsed = time.perf_counter() - start
                 times = self._worker.drain_stage_times() \
                     if hasattr(self._worker, 'drain_stage_times') else {}
@@ -186,7 +215,14 @@ class ThreadPool:
     supports_prefetch_hints = True
 
     def __init__(self, workers_count: int, results_queue_size: int = _RESULTS_QUEUE_SIZE_DEFAULT,
-                 profiling_enabled: bool = False, tracer=None):
+                 profiling_enabled: bool = False, tracer=None, recovery=None):
+        #: Worker auto-recovery options (``resilience.resolve_recovery``
+        #: shape) or ``None``: with recovery on, a worker thread killed by
+        #: an infra error or an injected crash is replaced and the items it
+        #: held are re-dispatched exactly once (docs/robustness.md).
+        self._recovery = recovery
+        self._respawns_used = 0
+        self._crash_counts = {}
         self._workers_count = workers_count
         self._work_queue: queue.Queue = queue.Queue()
         self._results_queue: queue.Queue = queue.Queue(maxsize=results_queue_size)
@@ -250,13 +286,18 @@ class ThreadPool:
         # queue is back-pressure, not decode; the worker thread subtracts
         # it from its process() wall time. The worker is constructed with
         # the wrapper, so its beat fn arrives via the holder afterwards.
-        publish_wait = {'s': 0.0}
+        # 'items' counts publications per worker: the crash handler uses it
+        # to decide whether a dying worker's current item already delivered
+        # its payload (then only the accounting is synthesized — never a
+        # redispatch, which would be a duplicate)
+        publish_wait = {'s': 0.0, 'items': 0}
         holder = {}
 
         def publish(item, _wait=publish_wait, _holder=holder):
             start = time.perf_counter()
             self._put_result(item, beat=_holder.get('beat'))
             _wait['s'] += time.perf_counter() - start
+            _wait['items'] += 1
 
         worker = worker_class(worker_id, publish, worker_args)
         holder['beat'] = getattr(worker, 'beat', None)
@@ -341,6 +382,104 @@ class ThreadPool:
         for thread in retired:
             thread.join(timeout=max(0.0, deadline - time.monotonic()))
         return still_pending
+
+    # -- worker auto-recovery (docs/robustness.md) -----------------------------
+
+    @staticmethod
+    def _item_key(item):
+        """Stable identity of a work item across epochs/redispatches (poison
+        accounting): reader items carry ``piece_index``/partition kwargs;
+        anything else keys by its repr."""
+        args, kwargs = item
+        piece_index = kwargs.get('piece_index') \
+            if isinstance(kwargs, dict) else None
+        if piece_index is None:
+            return ('raw', repr((args, kwargs))[:200])
+        return (piece_index,
+                tuple(kwargs.get('shuffle_row_drop_partition') or (0, 1)))
+
+    def _quarantine_poison(self, item, crash_count: int) -> None:
+        from petastorm_tpu.lineage import crash_quarantine_record
+        _args, kwargs = item
+        piece_index = kwargs.get('piece_index') \
+            if isinstance(kwargs, dict) else None
+        tracker = self.lineage
+        logger.error('poison item %s killed %d worker(s); quarantining it '
+                     'instead of crash-looping', self._item_key(item),
+                     crash_count)
+        if tracker is not None and tracker.enabled and piece_index is not None:
+            tracker.add_quarantines([crash_quarantine_record(
+                tracker, piece_index, kwargs.get('epoch', 0),
+                kwargs.get('shuffle_row_drop_partition', (0, 1)),
+                crash_count)])
+
+    def _handle_worker_crash(self, thread, current_item, pending_items,
+                             exc, published: bool) -> bool:
+        """A worker thread is dying mid-item. With recovery on (and budget
+        left): replace it, hand its items back, and return True — the dying
+        thread exits quietly. Exactly-once: a current item that already
+        published its payload is never re-dispatched (only its missing
+        accounting message is synthesized); un-published items go back on
+        the shared work queue, unless they crossed the poison threshold —
+        then they are quarantined through the lineage channel instead of
+        crash-looping the pool. Returns False when recovery is off,
+        budget-exhausted, or the pool is stopping (caller keeps the
+        pre-recovery behavior)."""
+        recovery = self._recovery
+        if recovery is None or self._stop_event.is_set():
+            return False
+        budget = recovery.get('max_respawns')
+        if budget is None:
+            budget = max(3, self._workers_count)
+        with self._resize_mutex:
+            if self._stop_event.is_set() or self._respawns_used >= budget:
+                if self._respawns_used >= budget:
+                    logger.error('worker respawn budget exhausted (%d); '
+                                 'letting the crash surface', budget)
+                return False
+            self._respawns_used += 1
+            with self._membership_lock:
+                if thread in self._threads:
+                    self._threads.remove(thread)
+                if thread._worker in self._workers:
+                    self._workers.remove(thread._worker)
+            worker_id = self._next_worker_id
+            self._next_worker_id += 1
+            self._spawn_worker(worker_id)
+        logger.warning('worker thread %s died (%s: %s); respawned as '
+                       'worker %d and re-dispatching %d item(s)',
+                       thread.name, type(exc).__name__, exc, worker_id,
+                       (0 if published else 1) + len(pending_items))
+        self.stats.add('worker_respawns')
+        poison_threshold = recovery.get('poison_threshold', 3)
+        # unlike the process pool (which cannot see inside a dead
+        # interpreter), the dying thread knows EXACTLY which item it was
+        # processing: only that item accumulates a crash count — innocents
+        # merely prefetched into the pending FIFO carry no suspicion — and
+        # it requeues LAST so the innocents complete before it can crash
+        # the replacement
+        redispatched = 0
+        for item in pending_items:
+            self._work_queue.put(item)
+            redispatched += 1
+        if published:
+            # payload already in the results queue: the item WAS delivered;
+            # synthesize only the accounting the dying worker never sent
+            self._put_result(VentilatedItemProcessedMessage())
+        else:
+            key = self._item_key(current_item)
+            count = self._crash_counts.get(key, 0) + 1
+            self._crash_counts[key] = count
+            if count >= poison_threshold:
+                self._quarantine_poison(current_item, count)
+                self.stats.add('poison_items_quarantined')
+                self._put_result(VentilatedItemProcessedMessage())
+            else:
+                self._work_queue.put(current_item)
+                redispatched += 1
+        if redispatched:
+            self.stats.add('items_redispatched', redispatched)
+        return True
 
     def set_readahead_depth(self, depth: int) -> None:
         """Live-set every worker's readahead prefetch depth (no-op for

@@ -1,0 +1,309 @@
+"""Chaos-hardened read path: the seeded fault-injection matrix.
+
+Every scenario below runs a **2-shard x 2-epoch** pass with the scenario's
+faults injected under the worker read path and asserts the one property the
+fault plane exists to guarantee: the run COMPLETES and the lineage
+``CoverageAuditor`` proves exactly-once delivery — faults degrade throughput,
+never correctness. Scenarios are deterministic by seed
+(``docs/robustness.md`` has the fault-model and knob tables)."""
+
+import time
+
+import pytest
+
+from petastorm_tpu import faultfs
+from petastorm_tpu.faultfs import SimulatedWorkerCrash
+from petastorm_tpu.health import classify_pipeline
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.test_util.dataset_gen import create_test_dataset
+from petastorm_tpu.transform import TransformSpec
+
+ROWS = 32
+SHARDS = 2
+EPOCHS = 2
+
+#: The fs-layer scenario matrix: env spec -> extra reader kwargs. Rates and
+#: latencies are tuned down from the production defaults so the whole
+#: matrix stays a CI-sized smoke; the seeds make each lane replayable.
+FS_SCENARIOS = {
+    'transient-errors': ('transient-errors:101', {}),
+    'truncated-reads': ('truncated-reads:202', {}),
+    'tail-latency': (
+        'tail-latency:303:tail_rate=0.08,tail_latency_s=0.05,'
+        'base_latency_s=0.001',
+        {'hedge': 0.02}),
+    'read-hangs': (
+        'read-hangs:404:hang_rate=0.1,hang_s=0.3',
+        {'hedge': 0.05}),
+    'worker-kill': (
+        'worker-kill:505:kill_after_reads=6,max_kills=2',
+        {}),
+}
+
+
+@pytest.fixture(scope='module')
+def chaos_dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('chaos') / 'dataset'
+    url = 'file://' + str(path)
+    create_test_dataset(url, range(ROWS), num_files=2)
+    return url
+
+
+@pytest.fixture
+def chaos_env(monkeypatch):
+    """Arm/clear the PETASTORM_TPU_CHAOS env around one test, with a fresh
+    injector cache so each test replays its scenario from occurrence 0."""
+    faultfs.reset_chaos_cache()
+
+    def arm(value):
+        monkeypatch.setenv(faultfs.CHAOS_ENV_VAR, value)
+    yield arm
+    faultfs.reset_chaos_cache()
+
+
+def _run_sharded_pass(url, pool_type, reader_kwargs=None,
+                      kill_proc_after_first=False):
+    """One 2-shard x 2-epoch pass; returns ``(reports, snapshots)`` after
+    asserting the coverage audit is exactly-once on every shard."""
+    reports, snapshots = [], []
+    for shard in range(SHARDS):
+        reader = make_reader(url, reader_pool_type=pool_type,
+                             workers_count=2, num_epochs=EPOCHS,
+                             cur_shard=shard, shard_count=SHARDS, seed=17,
+                             **(reader_kwargs or {}))
+        try:
+            iterator = iter(reader)
+            if kill_proc_after_first:
+                next(iterator)   # at least one delivery before the kill
+                reader._pool._processes[0].kill()
+            for _ in iterator:
+                pass
+            reports.append(reader.audit().assert_complete())
+            snapshots.append(reader.stats.snapshot())
+        finally:
+            reader.stop()
+            reader.join()
+    # zero unreported row loss: each epoch delivered the dataset exactly
+    # once across the two disjoint shards
+    for epoch in reports[0]['epochs']:
+        rows = sum(r['epochs'][epoch]['rows_delivered'] for r in reports)
+        quarantined = sum(r['epochs'][epoch]['rows_quarantined']
+                          for r in reports)
+        assert rows + quarantined == ROWS, (
+            'epoch {}: {} rows delivered + {} quarantined != {}'.format(
+                epoch, rows, quarantined, ROWS))
+    return reports, snapshots
+
+
+class TestChaosMatrixThreadPool:
+    @pytest.mark.timeout(180)
+    @pytest.mark.parametrize('scenario', sorted(FS_SCENARIOS))
+    def test_scenario_completes_exactly_once(self, chaos_dataset, chaos_env,
+                                             scenario):
+        spec, extra = FS_SCENARIOS[scenario]
+        chaos_env(spec)
+        _reports, snapshots = _run_sharded_pass(chaos_dataset, 'thread',
+                                                reader_kwargs=dict(extra))
+        injector = faultfs.chaos_from_env()
+        assert injector.injected, (
+            'scenario {} never injected a fault — the matrix proved '
+            'nothing'.format(scenario))
+        if scenario in ('transient-errors', 'truncated-reads'):
+            assert sum(s['io_retries'] for s in snapshots) > 0
+        if scenario == 'worker-kill':
+            assert sum(s['worker_respawns'] for s in snapshots) >= 1
+
+    @pytest.mark.timeout(180)
+    def test_hedges_fire_under_hangs(self, chaos_dataset, chaos_env):
+        spec, extra = FS_SCENARIOS['read-hangs']
+        chaos_env(spec)
+        _reports, snapshots = _run_sharded_pass(chaos_dataset, 'thread',
+                                                reader_kwargs=dict(extra))
+        assert sum(s['io_hedges'] for s in snapshots) >= 1
+        assert sum(s['io_hedge_wins'] for s in snapshots) >= 1
+
+    @pytest.mark.timeout(180)
+    def test_deterministic_by_seed(self, chaos_dataset, chaos_env):
+        """Same scenario + seed + access sequence -> the exact same faults
+        (1 worker, no shuffle: the access sequence is fixed)."""
+        tallies = []
+        for _ in range(2):
+            faultfs.reset_chaos_cache()
+            chaos_env('transient-errors:909')
+            reader = make_reader(chaos_dataset, reader_pool_type='thread',
+                                 workers_count=1, num_epochs=1,
+                                 shuffle_row_groups=False)
+            try:
+                for _ in reader:
+                    pass
+                reader.audit().assert_complete()
+            finally:
+                reader.stop()
+                reader.join()
+            tallies.append(dict(faultfs.chaos_from_env().injected))
+        assert tallies[0] == tallies[1]
+        assert tallies[0].get('transient_error', 0) > 0
+
+
+class TestChaosMatrixProcessPool:
+    @pytest.mark.timeout(300)
+    def test_transient_errors_complete_exactly_once(self, chaos_dataset,
+                                                    chaos_env):
+        spec, extra = FS_SCENARIOS['transient-errors']
+        chaos_env(spec)
+        _reports, snapshots = _run_sharded_pass(chaos_dataset, 'process',
+                                               reader_kwargs=dict(extra))
+        assert sum(s['io_retries'] for s in snapshots) > 0
+
+    @pytest.mark.slow
+    @pytest.mark.timeout(300)
+    @pytest.mark.parametrize('scenario',
+                             ['truncated-reads', 'tail-latency',
+                              'read-hangs'])
+    def test_scenario_completes_exactly_once(self, chaos_dataset, chaos_env,
+                                             scenario):
+        spec, extra = FS_SCENARIOS[scenario]
+        chaos_env(spec)
+        _run_sharded_pass(chaos_dataset, 'process',
+                          reader_kwargs=dict(extra))
+
+    @pytest.mark.timeout(300)
+    def test_killed_worker_mid_epoch_recovers(self, chaos_dataset):
+        """THE recovery acceptance (contrast
+        test_lineage.test_killed_process_worker_reports_drops, which pins
+        recovery OFF): a worker killed mid-epoch is respawned through the
+        saved bootstrap, its in-flight items are re-ventilated exactly
+        once, and the epoch COMPLETES with the auditor green — the kill
+        became a recovery, not a report."""
+        _reports, snapshots = _run_sharded_pass(
+            chaos_dataset, 'process', kill_proc_after_first=True)
+        assert sum(s['worker_respawns'] for s in snapshots) >= SHARDS
+        assert sum(s['items_redispatched'] for s in snapshots) >= 1
+        # the respawn surfaces as a named degradation, not silence
+        verdict = classify_pipeline({}, snapshots[0])
+        assert verdict['state'] == 'degraded'
+        assert any('worker-respawns' in c
+                   for c in verdict['degraded_causes'])
+
+
+class TestCacheEnospcDegrade:
+    @pytest.mark.timeout(300)
+    @pytest.mark.parametrize('pool_type', ['thread', 'process'])
+    def test_enospc_degrades_to_direct_decode(self, chaos_dataset, chaos_env,
+                                              tmp_path, pool_type):
+        """A cache that cannot publish (ENOSPC) must not fail the read
+        path: every fill falls through to direct decode, the epoch
+        completes exactly-once, and the degradation is a NAMED /healthz
+        cause, not silence."""
+        if pool_type == 'process':
+            pytest.importorskip('zmq')
+        chaos_env('cache-enospc:606')
+        cache_dir = tmp_path / 'cache-{}'.format(pool_type)
+        mem_dir = tmp_path / 'mem-{}'.format(pool_type)
+        _reports, snapshots = _run_sharded_pass(
+            chaos_dataset, pool_type,
+            reader_kwargs=dict(
+                cache_type='shared',
+                cache_location=str(cache_dir),
+                cache_size_limit=64 * 1024 * 1024,
+                cache_extra_settings={'mem_dir': str(mem_dir)}))
+        failures = sum(s['shared_put_failures'] for s in snapshots)
+        assert failures > 0, 'the ENOSPC scenario never fired'
+        verdict = classify_pipeline({}, snapshots[0])
+        assert verdict['state'] == 'degraded'
+        assert any('cache-degraded' in c for c in verdict['degraded_causes'])
+
+
+def _poison_row_transform(row):
+    if int(row['id']) == 7:
+        raise SimulatedWorkerCrash('poison row')
+    return row
+
+
+class TestPoisonItemQuarantine:
+    @pytest.mark.timeout(180)
+    @pytest.mark.parametrize('io_readahead', [0, 2])
+    def test_poison_item_quarantined_after_bounded_respawns(self, tmp_path,
+                                                            io_readahead):
+        """An item that kills its worker on every dispatch is quarantined
+        through the lineage channel after ``poison_threshold`` deaths —
+        bounded respawns, no crash loop, epoch completes, audit green.
+        With readahead on, innocents prefetched into the dying worker's
+        pending FIFO must NOT accumulate poison suspicion: exactly ONE
+        item quarantines either way."""
+        url = 'file://' + str(tmp_path / 'poison{}'.format(io_readahead))
+        create_test_dataset(url, range(ROWS), num_files=2)
+        reader = make_reader(
+            url, reader_pool_type='thread', workers_count=1, num_epochs=1,
+            shuffle_row_groups=False, io_readahead=io_readahead,
+            transform_spec=TransformSpec(func=_poison_row_transform),
+            worker_recovery=dict(poison_threshold=2, max_respawns=5))
+        try:
+            delivered = sum(1 for _ in reader)
+            report = reader.audit().assert_complete()
+            snapshot = reader.stats.snapshot()
+            assert snapshot['poison_items_quarantined'] == 1
+            assert snapshot['worker_respawns'] == 2
+            assert delivered < ROWS   # the poison group never delivers
+            epoch = report['epochs'][0]
+            assert epoch['quarantined_items'], \
+                'the poison item must be accounted as quarantined'
+            assert not epoch['dropped_items']
+            records = reader.lineage.quarantines()
+            assert any(r['stage'] == 'worker-crash' for r in records)
+            verdict = classify_pipeline({}, snapshot)
+            assert verdict['state'] == 'degraded'
+            assert any('poison-items' in c
+                       for c in verdict['degraded_causes'])
+        finally:
+            reader.stop()
+            reader.join()
+
+    @pytest.mark.timeout(180)
+    def test_permanent_io_error_stays_loud(self, tmp_path):
+        """Recovery is for crashes, not errors: a PERMANENT filesystem
+        error (deleted file, bad permissions) must surface to the consumer
+        even with worker_recovery on — quarantining it as a poison item
+        would be silent data loss."""
+        url = 'file://' + str(tmp_path / 'gone')
+        create_test_dataset(url, range(ROWS), num_files=2)
+
+        def missing_file(row):
+            raise FileNotFoundError('/data/part-0007.parquet')
+
+        reader = make_reader(
+            url, reader_pool_type='thread', workers_count=1, num_epochs=1,
+            shuffle_row_groups=False,
+            transform_spec=TransformSpec(func=missing_file))
+        try:
+            with pytest.raises(FileNotFoundError):
+                for _ in reader:
+                    pass
+            assert reader.stats.snapshot()['worker_respawns'] == 0
+        finally:
+            reader.stop()
+            reader.join()
+
+    @pytest.mark.timeout(180)
+    def test_respawn_budget_exhaustion_still_fails_loudly(self, tmp_path):
+        """When crashes outrun the budget, the pool must die loudly (a
+        recovery layer must never convert a crash loop into a hang)."""
+        url = 'file://' + str(tmp_path / 'budget')
+        create_test_dataset(url, range(ROWS), num_files=2)
+
+        def always_crash(row):
+            raise SimulatedWorkerCrash('every item crashes')
+
+        reader = make_reader(
+            url, reader_pool_type='thread', workers_count=1, num_epochs=1,
+            shuffle_row_groups=False,
+            transform_spec=TransformSpec(func=always_crash),
+            worker_recovery=dict(max_respawns=2, poison_threshold=99))
+        try:
+            with pytest.raises(BaseException):
+                deadline = time.monotonic() + 60
+                for _ in reader:
+                    assert time.monotonic() < deadline
+        finally:
+            reader.stop()
+            reader.join()

@@ -339,7 +339,7 @@ class TestReaderIntegration:
         contract), and the pool still dies loudly."""
         reader = make_reader(latency_dataset, reader_pool_type='process',
                              workers_count=1, num_epochs=1,
-                             shuffle_row_groups=False)
+                             shuffle_row_groups=False, worker_recovery=False)
         try:
             iterator = iter(reader)
             # consume until at least one worker accounting message (which

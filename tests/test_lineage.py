@@ -244,12 +244,15 @@ class TestCoverageAudit:
 
     @pytest.mark.timeout(120)
     def test_killed_process_worker_reports_drops(self, tmp_path):
-        """A worker killed mid-epoch yields REPORTED drops with their source
-        row groups — never a silent gap."""
+        """With worker auto-recovery OFF, a worker killed mid-epoch yields
+        REPORTED drops with their source row groups — never a silent gap.
+        (With recovery on — the default — the same kill becomes a respawn +
+        exactly-once redispatch instead: tests/test_chaos.py.)"""
         url = 'file://' + str(tmp_path / 'droppy')
         create_test_dataset(url, range(32), num_files=2)
         reader = make_reader(url, reader_pool_type='process', workers_count=1,
-                             num_epochs=1, shuffle_row_groups=False)
+                             num_epochs=1, shuffle_row_groups=False,
+                             worker_recovery=False)
         try:
             iterator = iter(reader)
             next(iterator)   # at least one delivery before the kill

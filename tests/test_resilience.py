@@ -1,0 +1,406 @@
+"""Unit tests for the unified resilient-IO layer (petastorm_tpu/resilience.py)
+and the deterministic fault injector (petastorm_tpu/faultfs.py)."""
+
+import errno
+import threading
+import time
+
+import pytest
+
+from petastorm_tpu import faultfs, resilience
+from petastorm_tpu.faultfs import FaultInjector, SimulatedWorkerCrash
+from petastorm_tpu.fs import retry_filesystem_call
+from petastorm_tpu.lineage import LineageTracker
+from petastorm_tpu.resilience import (AdaptiveThreshold, HedgedRead,
+                                      ResilientIO, RetryPolicy,
+                                      classify_error, classify_read_error,
+                                      resolve_hedge, resolve_recovery,
+                                      resolve_retry)
+
+
+class TestClassification:
+    def test_request_shaped_errors_are_permanent(self):
+        assert classify_error(FileNotFoundError('x')) == 'permanent'
+        assert classify_error(PermissionError('x')) == 'permanent'
+        assert classify_error(IsADirectoryError('x')) == 'permanent'
+        assert classify_error(OSError(errno.ENOSPC, 'full')) == 'permanent'
+
+    def test_connection_shaped_errors_are_transient(self):
+        assert classify_error(OSError(errno.EIO, 'io')) == 'transient'
+        assert classify_error(ConnectionResetError()) == 'transient'
+        assert classify_error(TimeoutError()) == 'transient'
+        assert classify_error(OSError('no errno at all')) == 'transient'
+
+    def test_non_os_errors_are_permanent(self):
+        assert classify_error(ValueError('bug')) == 'permanent'
+        assert classify_error(KeyError('bug')) == 'permanent'
+
+    def test_pyarrow_parse_errors_are_transient_for_reads(self):
+        pa = pytest.importorskip('pyarrow')
+        exc = pa.lib.ArrowInvalid('truncated stream')
+        assert classify_error(exc) == 'permanent'
+        assert classify_read_error(exc) == 'transient'
+
+
+class TestRetryPolicy:
+    def test_transient_retried_until_success(self):
+        calls = {'n': 0}
+
+        def flaky():
+            calls['n'] += 1
+            if calls['n'] < 3:
+                raise OSError(errno.EIO, 'transient')
+            return 'ok'
+
+        policy = RetryPolicy(attempts=3, initial_backoff_s=0.001, seed=0)
+        events = {}
+        assert policy.call(flaky, on_event=lambda k, n: events.update(
+            {k: events.get(k, 0) + n})) == 'ok'
+        assert calls['n'] == 3
+        assert events['io_retries'] == 2
+
+    def test_permanent_fails_in_one_attempt(self):
+        calls = {'n': 0}
+
+        def missing():
+            calls['n'] += 1
+            raise FileNotFoundError('/no/such/path')
+
+        policy = RetryPolicy(attempts=3, initial_backoff_s=0.001, seed=0)
+        events = {}
+        with pytest.raises(FileNotFoundError):
+            policy.call(missing, on_event=lambda k, n: events.update(
+                {k: events.get(k, 0) + n}))
+        assert calls['n'] == 1, 'a bad path must not burn the retry budget'
+        assert events['io_permanent_failures'] == 1
+
+    def test_attempts_exhausted_raises_last_error(self):
+        calls = {'n': 0}
+
+        def always():
+            calls['n'] += 1
+            raise OSError(errno.EIO, 'still down')
+
+        policy = RetryPolicy(attempts=3, initial_backoff_s=0.001, seed=0)
+        with pytest.raises(OSError, match='still down'):
+            policy.call(always)
+        assert calls['n'] == 3
+
+    def test_total_wall_budget_caps_retries(self):
+        calls = {'n': 0}
+
+        def slow_fail():
+            calls['n'] += 1
+            time.sleep(0.05)
+            raise OSError(errno.EIO, 'down')
+
+        policy = RetryPolicy(attempts=100, initial_backoff_s=0.001,
+                             total_budget_s=0.1, seed=0)
+        start = time.monotonic()
+        with pytest.raises(OSError):
+            policy.call(slow_fail)
+        assert time.monotonic() - start < 2.0
+        assert calls['n'] < 100
+
+    def test_backoff_has_full_jitter(self):
+        policy = RetryPolicy(attempts=10, initial_backoff_s=0.1,
+                             max_backoff_s=1.0, seed=42)
+        draws = [policy.backoff_s(3) for _ in range(50)]
+        # full jitter: uniform in [0, ceiling] — spread, and some well
+        # below the ceiling (a fixed-step backoff would put all at 0.8)
+        assert max(draws) <= 0.8
+        assert min(draws) < 0.4
+        assert len({round(d, 6) for d in draws}) > 10
+
+    def test_on_retry_hook_runs_between_attempts(self):
+        rotations = []
+
+        def flaky():
+            if len(rotations) < 2:
+                raise OSError(errno.EIO, 'x')
+            return 'ok'
+
+        policy = RetryPolicy(attempts=5, initial_backoff_s=0.001, seed=0)
+        assert policy.call(
+            flaky, on_retry=lambda e, a: rotations.append(a)) == 'ok'
+        assert rotations == [0, 1]
+
+
+class TestKnobResolution:
+    def test_retry_defaults_and_off(self):
+        assert resolve_retry(None)['attempts'] == 3
+        assert resolve_retry(True)['attempts'] == 3
+        assert resolve_retry(False) is None
+        assert resolve_retry({'attempts': 5})['attempts'] == 5
+
+    def test_retry_typo_fails(self):
+        with pytest.raises(ValueError, match='unknown retry option'):
+            resolve_retry({'atempts': 5})
+
+    def test_hedge_shapes(self):
+        assert resolve_hedge(None) is None
+        assert resolve_hedge(False) is None
+        assert resolve_hedge(True)['threshold_s'] is None
+        assert resolve_hedge(0.05)['threshold_s'] == 0.05
+        assert resolve_hedge({'threshold_s': 0.1})['threshold_s'] == 0.1
+        with pytest.raises(ValueError, match='unknown hedge option'):
+            resolve_hedge({'treshold_s': 0.1})
+
+    def test_recovery_shapes(self):
+        assert resolve_recovery(None)['poison_threshold'] == 3
+        assert resolve_recovery(False) is None
+        assert resolve_recovery({'settle_s': 0.2})['settle_s'] == 0.2
+        with pytest.raises(ValueError, match='unknown worker_recovery'):
+            resolve_recovery({'max_respawn': 1})
+
+
+class TestAdaptiveThreshold:
+    def test_warmup_returns_none(self):
+        t = AdaptiveThreshold(warmup=8)
+        for _ in range(7):
+            t.observe(0.01)
+        assert t.current() is None
+        t.observe(0.01)
+        assert t.current() is not None
+
+    def test_p95_scaled_and_clamped(self):
+        t = AdaptiveThreshold(scale=2.0, min_s=0.005, max_s=5.0, warmup=4)
+        for _ in range(100):
+            t.observe(0.01)
+        assert t.current() == pytest.approx(0.02, rel=0.2)
+        t2 = AdaptiveThreshold(scale=2.0, min_s=0.05, max_s=5.0, warmup=4)
+        for _ in range(10):
+            t2.observe(0.0001)
+        assert t2.current() == 0.05   # clamped at the floor
+
+
+class TestHedgedRead:
+    def test_fast_primary_never_hedges(self):
+        hedge = HedgedRead(dict(resilience.DEFAULT_HEDGE, threshold_s=0.5))
+        events = []
+        hedge._on_event = lambda k, n=1: events.append(k)
+        assert hedge.call(lambda: 'fast') == 'fast'
+        assert events == []
+
+    def test_slow_primary_hedged_and_hedge_wins(self):
+        events = {}
+
+        def count(k, n=1):
+            events[k] = events.get(k, 0) + n
+
+        hedge = HedgedRead(dict(resilience.DEFAULT_HEDGE, threshold_s=0.02),
+                           on_event=count)
+        release = threading.Event()
+
+        def slow_primary():
+            release.wait(5.0)
+            return 'primary'
+
+        result = hedge.call(slow_primary, hedge_fn=lambda: 'hedge')
+        release.set()
+        assert result == 'hedge'
+        assert events.get('io_hedges') == 1
+        assert events.get('io_hedge_wins') == 1
+
+    def test_primary_wins_when_hedge_is_slow(self):
+        events = {}
+        hedge = HedgedRead(dict(resilience.DEFAULT_HEDGE, threshold_s=0.01),
+                           on_event=lambda k, n=1: events.update(
+                               {k: events.get(k, 0) + n}))
+        release = threading.Event()
+
+        def slowish_primary():
+            time.sleep(0.05)
+            return 'primary'
+
+        def slow_hedge():
+            release.wait(5.0)
+            return 'hedge'
+
+        result = hedge.call(slowish_primary, hedge_fn=slow_hedge)
+        release.set()
+        assert result == 'primary'
+        assert events.get('io_hedges') == 1
+        assert 'io_hedge_wins' not in events
+
+    def test_first_finisher_error_propagates(self):
+        hedge = HedgedRead(dict(resilience.DEFAULT_HEDGE, threshold_s=5.0))
+
+        def boom():
+            raise OSError(errno.EIO, 'injected')
+
+        with pytest.raises(OSError, match='injected'):
+            hedge.call(boom)
+
+    def test_warmup_runs_inline(self):
+        hedge = HedgedRead(dict(resilience.DEFAULT_HEDGE))  # adaptive
+        assert hedge.threshold_s() is None
+        assert hedge.call(lambda: 42) == 42
+
+
+class TestResilientIO:
+    def test_retry_then_success_counts_drain(self):
+        io = ResilientIO(dict(resilience.DEFAULT_RETRY,
+                              initial_backoff_s=0.001))
+        calls = {'n': 0}
+
+        def flaky():
+            calls['n'] += 1
+            if calls['n'] < 2:
+                raise OSError(errno.EIO, 'x')
+            return 'ok'
+
+        assert io.read(flaky) == 'ok'
+        events = io.take_events()
+        assert events == {'io_retries': 1}
+        assert io.take_events() == {}   # drained
+
+    def test_disabled_passthrough(self):
+        io = ResilientIO(None, None)
+        assert not io.enabled
+
+
+class TestFaultInjectorDeterminism:
+    def test_same_seed_same_decisions(self):
+        tallies = []
+        for _ in range(2):
+            injector = FaultInjector('transient-errors', seed=1234)
+            outcome = []
+            for i in range(200):
+                path = '/data/part-{}.parquet'.format(i % 4)
+                try:
+                    injector.before_read(path)
+                    outcome.append(0)
+                except OSError:
+                    outcome.append(1)
+            tallies.append(outcome)
+        assert tallies[0] == tallies[1]
+        assert sum(tallies[0]) > 0, 'the scenario must actually inject'
+
+    def test_different_seed_different_decisions(self):
+        def run(seed):
+            injector = FaultInjector('transient-errors', seed=seed)
+            outcome = []
+            for i in range(200):
+                path = '/data/part-{}.parquet'.format(i % 4)
+                try:
+                    injector.before_read(path)
+                    outcome.append(0)
+                except OSError:
+                    outcome.append(1)
+            return outcome
+        assert run(1) != run(2)
+
+    def test_consecutive_cap_guarantees_retry_recovery(self):
+        injector = FaultInjector('transient-errors', seed=0, error_rate=1.0)
+        with pytest.raises(OSError):
+            injector.before_read('/data/x.parquet')
+        # rate 1.0, but max_consecutive=1: the retry always succeeds
+        injector.before_read('/data/x.parquet')
+
+    def test_truncation_is_deterministic_and_capped(self):
+        injector = FaultInjector('truncated-reads', seed=5, truncate_rate=1.0)
+        data = b'x' * 100
+        first = injector.after_read('/d/a.parquet', data)
+        second = injector.after_read('/d/a.parquet', data)
+        assert len(first) == 50
+        assert len(second) == 100   # consecutive cap
+
+    def test_worker_kill_fires_once(self):
+        injector = FaultInjector('worker-kill', seed=0, kill_after_reads=3)
+        for _ in range(2):
+            injector.before_read('/d/a.parquet')
+        with pytest.raises(SimulatedWorkerCrash):
+            injector.before_read('/d/a.parquet')
+        for _ in range(10):
+            injector.before_read('/d/a.parquet')   # max_kills=1: no more
+
+    def test_unknown_scenario_and_param_fail(self):
+        with pytest.raises(ValueError, match='unknown chaos scenario'):
+            FaultInjector('tail-latencies')
+        with pytest.raises(ValueError, match='param'):
+            FaultInjector('tail-latency', tail_rte=0.1)
+
+    def test_cache_enospc_hook(self):
+        injector = FaultInjector('cache-enospc', seed=0)
+        with pytest.raises(OSError) as info:
+            injector.cache_put_fault('digest0')
+        assert info.value.errno == errno.ENOSPC
+        # fs scenarios never fire the cache hook
+        FaultInjector('tail-latency', seed=0).cache_put_fault('digest0')
+
+
+class TestChaosEnv:
+    def test_parse_with_seed_and_overrides(self):
+        injector = faultfs.parse_chaos(
+            'tail-latency:7:tail_rate=0.1,tail_latency_s=0.05')
+        assert injector.scenario == 'tail-latency'
+        assert injector.seed == 7
+        assert injector.params['tail_rate'] == pytest.approx(0.1)
+        assert injector.params['tail_latency_s'] == pytest.approx(0.05)
+
+    def test_parse_none_and_empty(self):
+        assert faultfs.parse_chaos('') is None
+        assert faultfs.parse_chaos('none') is None
+
+    def test_typo_raises(self):
+        with pytest.raises(ValueError):
+            faultfs.parse_chaos('tail-latncy:3')
+
+    def test_maybe_wrap_gates_on_env(self, monkeypatch):
+        faultfs.reset_chaos_cache()
+        monkeypatch.delenv(faultfs.CHAOS_ENV_VAR, raising=False)
+        sentinel = object()
+        assert faultfs.maybe_wrap(sentinel) is sentinel
+        monkeypatch.setenv(faultfs.CHAOS_ENV_VAR, 'transient-errors:3')
+        wrapped = faultfs.maybe_wrap(sentinel)
+        assert isinstance(wrapped, faultfs.FaultyFilesystem)
+        # cache-enospc injects at the cache layer, not the fs layer
+        faultfs.reset_chaos_cache()
+        monkeypatch.setenv(faultfs.CHAOS_ENV_VAR, 'cache-enospc:3')
+        assert faultfs.maybe_wrap(sentinel) is sentinel
+        faultfs.reset_chaos_cache()
+
+
+class TestRetryFilesystemCallSatellite:
+    def test_permanent_error_fails_in_one_attempt(self):
+        calls = {'n': 0}
+
+        @retry_filesystem_call(attempts=3, initial_delay_s=0.001)
+        def missing():
+            calls['n'] += 1
+            raise FileNotFoundError('/typo/path')
+
+        start = time.monotonic()
+        with pytest.raises(FileNotFoundError):
+            missing()
+        assert calls['n'] == 1, ('a bad path must fail in 1 attempt, not 3 '
+                                 'with delays')
+        assert time.monotonic() - start < 0.5
+
+
+class TestDeliveryDeficit:
+    def _tracker(self):
+        return LineageTracker(enabled=True, dataset_digest='d',
+                              pieces=[('/p.parquet', 0, 10)],
+                              items=[(0, (0, 1))])
+
+    def test_undelivered_item_has_deficit(self):
+        tracker = self._tracker()
+        tracker.record_ventilated(0, 0, (0, 1))
+        assert tracker.delivery_deficit(0, 0, (0, 1)) == 1
+
+    def test_delivered_item_has_no_deficit(self):
+        from petastorm_tpu.lineage import Provenance
+        tracker = self._tracker()
+        tracker.record_ventilated(0, 0, (0, 1))
+        tracker.register(Provenance('d', 0, '/p.parquet', 0, 10, ('all', 10),
+                                    0, -1, 0, (0, 1), 0))
+        assert tracker.delivery_deficit(0, 0, (0, 1)) == 0
+
+    def test_unknown_epoch_is_none(self):
+        assert self._tracker().delivery_deficit(9, 0, (0, 1)) is None
+
+    def test_disabled_tracker_is_none(self):
+        tracker = LineageTracker(enabled=False)
+        assert tracker.delivery_deficit(0, 0, (0, 1)) is None

@@ -174,7 +174,8 @@ class TestReaderTracing:
         """Spans shipped before a worker dies stay in the tracer, and the
         pool's death report does not corrupt the trace export."""
         with make_reader(synthetic_dataset.url, reader_pool_type='process',
-                         workers_count=2, num_epochs=None, trace=True) as reader:
+                         workers_count=2, num_epochs=None, trace=True,
+                         worker_recovery=False) as reader:
             it = iter(reader)
             for _ in range(5):
                 next(it)
@@ -341,7 +342,8 @@ class TestReaderShutdownLifecycle:
         reader = make_reader(synthetic_dataset.url, reader_pool_type='process',
                              workers_count=2, num_epochs=None,
                              metrics_interval=0.05, metrics_out=str(out),
-                             debug_port=0, stall_timeout=30)
+                             debug_port=0, stall_timeout=30,
+                             worker_recovery=False)
         it = iter(reader)
         for _ in range(5):
             next(it)
