@@ -144,7 +144,8 @@ class MonotonicClockRule(Rule):
              'petastorm_tpu/latency.py', 'petastorm_tpu/profiler.py',
              'petastorm_tpu/autotune.py', 'petastorm_tpu/workers/*',
              'petastorm_tpu/readers/readahead.py',
-             'petastorm_tpu/resilience.py', 'petastorm_tpu/faultfs.py')
+             'petastorm_tpu/resilience.py', 'petastorm_tpu/faultfs.py',
+             'petastorm_tpu/ops/decode.py')
     _WALL_CALLS = ('time.time', 'datetime.now', 'datetime.datetime.now',
                    'datetime.utcnow', 'datetime.datetime.utcnow')
 

@@ -215,6 +215,31 @@ def _decode_batch_entries(artifact, round_no, blob):
     return entries
 
 
+def _device_decode_entries(artifact, round_no, blob):
+    """Entries from a ``benchmark/device_decode.py`` result (r17): one
+    series per measured line (device bytes-through vs host batched are
+    distinct configs — like-for-like gating). The device line carries its
+    %-of-ingest-ceiling as roofline context: under bytes-through the raw
+    staging link is the ceiling the paper says should bind, so that is
+    the fraction worth trending."""
+    entries = []
+    roof = blob.get('roofline') or {}
+    for name, line in (blob.get('lines') or {}).items():
+        sps = line.get('samples_per_sec')
+        if not isinstance(sps, (int, float)):
+            continue
+        config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+                  'rows': blob.get('rows'),
+                  'backend': blob.get('jax_backend'),
+                  'workers': (blob.get('protocol') or {}).get('workers')}
+        roofline_pct = (roof.get('pct_of_ingest_ceiling')
+                        if name == blob.get('headline_line') else None)
+        entries.append(_entry(artifact, round_no,
+                              'device_decode.{}'.format(name), config, sps,
+                              roofline_pct=roofline_pct))
+    return entries
+
+
 def _overhead_entries(artifact, round_no, blob):
     """Entries from the alternating-pass overhead benches (r08/r09/r10, and
     r14's latency-overhead record which additionally carries its measured
@@ -324,6 +349,8 @@ def normalize_artifact(name: str, blob: dict):
         entries.extend(_roofline_entries(name, round_no, payload))
     elif payload.get('benchmark', '').startswith('decode_batch'):
         entries.extend(_decode_batch_entries(name, round_no, payload))
+    elif payload.get('benchmark', '').startswith('device_decode'):
+        entries.extend(_device_decode_entries(name, round_no, payload))
     elif payload.get('benchmark', '').startswith('autotune'):
         entries.extend(_autotune_entries(name, round_no, payload))
     elif payload.get('benchmark', '') == 'chaos':

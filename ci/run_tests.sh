@@ -101,6 +101,12 @@ python -m petastorm_tpu.benchmark.decode_batch --quick
 echo '== batched-decode quick checks (bit-identity property tests, quarantine, lineage audit) =='
 python -m pytest tests/test_decode_batch.py -q
 
+echo '== device-decode quick checks (bytes-through plan/decline matrix, jit bit-identity, coverage audit) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_device_decode.py -q
+
+echo '== device-decode quick bench (kill-switch A/B, raw-shipping counters, probe ceilings) =='
+JAX_PLATFORMS=cpu python -m petastorm_tpu.benchmark.device_decode --quick
+
 echo '== bench-docs consistency gate =='
 python ci/check_bench_docs.py
 

@@ -35,6 +35,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "int or 'auto' (overlap storage I/O with "
                              "decode; see docs/readahead.md)")
     parser.add_argument('--jax-batch-size', type=int, default=16)
+    parser.add_argument('--prefetch-depth', type=int, default=None,
+                        help='device-staging prefetch depth for the jax read '
+                             'method (batches materialized ahead of the '
+                             'consumer; default: '
+                             'PETASTORM_TPU_PREFETCH_DEPTH or 2 — see '
+                             'docs/readahead.md; owned by this flag, the '
+                             'autotuner does not actuate it)')
     parser.add_argument('-r', '--runs', type=int, default=1,
                         help='Repeat the measurement N times and report '
                              'best/median/min + spread (noisy shared hosts '
@@ -145,6 +152,7 @@ def main(argv=None) -> int:
         shuffling_queue_size=args.shuffling_queue_size,
         read_method=args.read_method, batch_reader=args.batch_reader,
         jax_batch_size=args.jax_batch_size,
+        prefetch_depth=args.prefetch_depth,
         io_readahead=io_readahead, trace_path=args.trace,
         metrics_interval=args.metrics_interval,
         metrics_out=args.metrics_out, debug_port=args.debug_port,

@@ -80,6 +80,7 @@ def reader_throughput(dataset_url: str,
                       read_method: str = 'python',
                       batch_reader: bool = False,
                       jax_batch_size: int = 0,
+                      prefetch_depth: Optional[int] = None,
                       io_readahead=0,
                       trace=None,
                       trace_path: Optional[str] = None,
@@ -129,7 +130,8 @@ def reader_throughput(dataset_url: str,
         if read_method == 'jax':
             from petastorm_tpu.jax_utils import JaxDataLoader
             loader = JaxDataLoader(reader, batch_size=jax_batch_size or 16,
-                                   shuffling_queue_capacity=shuffling_queue_size)
+                                   shuffling_queue_capacity=shuffling_queue_size,
+                                   prefetch_depth=prefetch_depth)
             iterator = iter(loader)
             batched = True
         elif read_method == 'python':

@@ -107,6 +107,16 @@ class DataframeColumnCodec(ABC):
         an approximation."""
         return None
 
+    def device_decode_unsupported_reason(self, unischema_field):
+        """``None`` when this codec's stored cells for ``unischema_field``
+        can decode on the accelerator under ``jax.jit``
+        (``ops/decode.py``), else a human-readable decline reason. The
+        default is ineligible: device decode is opt-in per codec, and a
+        decline routes the column to the host matrix — it never owns an
+        error."""
+        return 'codec {} has no device-decode path'.format(
+            type(self).__name__)
+
     @abstractmethod
     def arrow_type(self, unischema_field) -> pa.DataType:
         """The pyarrow storage type used for this field's column."""
@@ -307,6 +317,30 @@ class NdarrayCodec(DataframeColumnCodec):
             return payload.view(dtype).reshape((n,) + cell_shape)
         return decode_chunk
 
+    def device_decode_unsupported_reason(self, unischema_field):
+        """Eligible when the stored layout is statically provable: fixed
+        shape (every cell shares one ``np.save`` header), non-nullable
+        (the raw grid has no slot for missing cells), plain little-endian
+        numeric/bool dtype (``lax.bitcast_convert_type`` reinterprets
+        native-order bytes only)."""
+        import sys
+        shape = unischema_field.shape
+        if shape is None or any(s is None for s in shape):
+            return 'wildcard shape: cells do not share one np.save header'
+        if unischema_field.nullable:
+            return 'nullable field: the raw grid has no missing-cell slot'
+        try:
+            dtype = np.dtype(unischema_field.numpy_dtype)
+        except TypeError:
+            return 'field dtype is not a numpy dtype'
+        if dtype.kind not in 'biuf':
+            return 'dtype kind {!r} is not device-representable'.format(
+                dtype.kind)
+        if dtype.itemsize > 1 and (dtype.str[0] == '>'
+                                   or sys.byteorder != 'little'):
+            return 'big-endian payload: device bitcast is little-endian'
+        return None
+
     def arrow_type(self, unischema_field):
         return pa.binary()
 
@@ -371,6 +405,14 @@ class CompressedNdarrayCodec(DataframeColumnCodec):
         def decode_cell(cell):   # BytesIO accepts buffer views directly
             return np.load(io.BytesIO(cell))['arr']
         return decode_cell
+
+    def device_decode_unsupported_reason(self, unischema_field):
+        """zlib streams stay a host decode: there is no jittable inflate.
+        The device-eligible route for compressed stores is an ETL-time
+        repack to the raw ``NdarrayCodec`` layout
+        (``etl/repack.py::repack_to_ndarray_codec``)."""
+        return ('zlib inflate has no device path — repack the store to '
+                'NdarrayCodec via etl.repack to make it device-eligible')
 
     def arrow_type(self, unischema_field):
         return pa.binary()

@@ -1,0 +1,93 @@
+"""ETL-time repack: rewrite a store's compressed ndarray columns as plain
+``NdarrayCodec`` so they become device-decode eligible.
+
+``CompressedNdarrayCodec`` (zlib) has no device decode path — inflate is a
+host algorithm — so a bytes-through reader permanently declines those
+columns to the host matrix (``docs/decode.md``). The trade is storage
+bytes for decode CPU; on an accelerator host whose ingest link is the
+intended ceiling (PAPER §5.8), the right place to pay zlib is ONCE at ETL
+time, not per epoch per worker. This module is that one-time payment:
+stream-decode the source store and materialize a copy whose compressed
+ndarray fields carry :class:`~petastorm_tpu.codecs.NdarrayCodec` — the
+strict ``np.save`` v1 layout ``ops.decode`` can plan against. Parquet-level
+compression (snappy by default) still applies on top, so the size
+regression is bounded while the decode stays a header-strip + bitcast.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from petastorm_tpu.codecs import CompressedNdarrayCodec, NdarrayCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+def repack_schema(schema: Unischema,
+                  fields: Optional[List[str]] = None) -> Unischema:
+    """``(post_repack_schema, repacked_names)``: every
+    :class:`~petastorm_tpu.codecs.CompressedNdarrayCodec` field (or just
+    the named ``fields``) re-declared with
+    :class:`~petastorm_tpu.codecs.NdarrayCodec`; everything else verbatim.
+    Raises ``ValueError`` when ``fields`` names a column that is not
+    compressed-ndarray encoded (a silent no-op would hide a typo)."""
+    wanted = set(fields) if fields is not None else None
+    unknown = (wanted or set()) - set(schema.fields)
+    if unknown:
+        raise ValueError('repack fields name unknown columns: {}'.format(
+            sorted(unknown)))
+    out_fields = []
+    repacked = []
+    for name, field in schema.fields.items():
+        eligible = isinstance(field.codec, CompressedNdarrayCodec)
+        if wanted is not None and name in wanted and not eligible:
+            raise ValueError(
+                'field {!r} is not CompressedNdarrayCodec-encoded ({}); '
+                'only zlib ndarray columns repack'.format(
+                    name, type(field.codec).__name__))
+        if eligible and (wanted is None or name in wanted):
+            out_fields.append(UnischemaField(name, field.numpy_dtype,
+                                             field.shape, NdarrayCodec(),
+                                             field.nullable))
+            repacked.append(name)
+        else:
+            out_fields.append(field)
+    return Unischema(schema._name + '_repacked', out_fields), repacked
+
+
+def repack_to_ndarray_codec(source_url: str, output_url: str,
+                            fields: Optional[List[str]] = None,
+                            row_group_size_mb: float = 4.0,
+                            compression: str = 'snappy',
+                            overwrite: bool = False) -> Dict:
+    """Materialize a device-decode-eligible copy of ``source_url`` at
+    ``output_url``: compressed ndarray columns inflate once here and store
+    as raw ``np.save`` payloads. Returns a summary dict
+    (``rows``, ``repacked_fields``, ``output_url``).
+
+    The copy streams through a columnar reader (decode happens on the
+    reader's host matrix — this tool never needs an accelerator), so
+    arbitrarily large stores repack in bounded memory, one row group at a
+    time."""
+    from petastorm_tpu.etl.dataset_metadata import (get_schema_from_dataset_url,
+                                                    materialize_dataset)
+    from petastorm_tpu.reader import make_columnar_reader
+
+    schema = get_schema_from_dataset_url(source_url)
+    out_schema, repacked = repack_schema(schema, fields)
+    rows = 0
+    with materialize_dataset(output_url, out_schema,
+                             row_group_size_mb=row_group_size_mb,
+                             compression=compression,
+                             overwrite=overwrite) as writer:
+        with make_columnar_reader(source_url, num_epochs=1,
+                                  shuffle_row_groups=False) as reader:
+            names = list(out_schema.fields)
+            for batch in reader:
+                columns = {name: getattr(batch, name) for name in names}
+                n = len(next(iter(columns.values()))) if columns else 0
+                for i in range(n):
+                    writer.write_row({name: col[i]
+                                      for name, col in columns.items()})
+                rows += n
+    return {'rows': rows, 'repacked_fields': repacked,
+            'output_url': output_url}

@@ -23,6 +23,7 @@ transfer unless the caller handles them.
 
 import collections
 import logging
+import os
 import threading
 import time
 from decimal import Decimal
@@ -73,6 +74,8 @@ def _contiguous_rows_view(vals):
     object cells — and the caller keeps the copying path. The slice shares
     the column's memory (and its writability): treat collated batches as
     read-only, as ``docs/decode.md`` documents."""
+    if not len(vals):
+        return None
     first = vals[0]
     base = first.base
     if base is None or not isinstance(base, np.ndarray) or first.ndim == 0:
@@ -96,6 +99,39 @@ def _contiguous_rows_view(vals):
                 or v.__array_interface__['data'][0] != ptr):
             return None
     return base[start:start + len(vals)]
+
+
+#: Environment default for the device-prefetch window
+#: (:func:`prefetch_to_device` / :func:`prefetch_batches` ``size`` and the
+#: loaders' ``prefetch_depth`` knob). Unset means :data:`DEFAULT_PREFETCH_DEPTH`.
+PREFETCH_DEPTH_ENV_VAR = 'PETASTORM_TPU_PREFETCH_DEPTH'
+
+#: Double-buffering: stage batch N+1 while batch N computes. Depths beyond
+#: 2-4 only pay off when step times are highly variable (docs/readahead.md).
+DEFAULT_PREFETCH_DEPTH = 2
+
+
+def resolve_prefetch_depth(depth):
+    """Validated prefetch depth: the explicit knob wins, then
+    :data:`PREFETCH_DEPTH_ENV_VAR`, then :data:`DEFAULT_PREFETCH_DEPTH`."""
+    if depth is None:
+        raw = os.environ.get(PREFETCH_DEPTH_ENV_VAR, '').strip()
+        if not raw:
+            return DEFAULT_PREFETCH_DEPTH
+        depth = raw
+    if isinstance(depth, float):
+        # int() would silently truncate 2.5 -> 2; a fractional depth is a
+        # caller bug worth surfacing
+        raise ValueError('prefetch depth must be an integer >= 1, got {!r}'
+                         .format(depth))
+    try:
+        depth = int(depth)
+    except (TypeError, ValueError):
+        raise ValueError('prefetch depth must be an integer >= 1, got {!r}'
+                         .format(depth))
+    if depth < 1:
+        raise ValueError('prefetch depth must be >= 1, got {}'.format(depth))
+    return depth
 
 
 def validate_pad_spec(pad_spec):
@@ -273,6 +309,25 @@ class JaxLoaderBase(object):
         #: ``infeed_wait``/``train_step`` duration histograms even with
         #: tracing off — tail latencies must not require a span ring.
         self.stats = getattr(reader, 'stats', None)
+        #: Background lookahead window for :meth:`iter_prefetched`; subclass
+        #: constructors overwrite it from their ``prefetch_depth`` knob.
+        self.prefetch_depth = resolve_prefetch_depth(None)
+
+    def iter_prefetched(self, sharding=None, to_device=True):
+        """Iterate with a background lookahead of ``self.prefetch_depth``
+        batches: :func:`prefetch_to_device` when ``to_device`` (explicit
+        per-batch ``jax.device_put``, overlapping the H2D DMA with compute),
+        else :func:`prefetch_batches` (host lookahead; the jitted step's own
+        call transfers). The depth is a loader knob — set it at construction
+        (``prefetch_depth=``), via ``PETASTORM_TPU_PREFETCH_DEPTH``, or by
+        assigning ``loader.prefetch_depth`` before calling this
+        (docs/readahead.md documents who owns the knob)."""
+        if to_device:
+            return prefetch_to_device(iter(self), self.prefetch_depth,
+                                      sharding=sharding, stats=self.stats,
+                                      tracer=self.tracer, health=self.health)
+        return prefetch_batches(iter(self), self.prefetch_depth,
+                                health=self.health)
 
     def __iter__(self):
         if self._error is not None:
@@ -369,7 +424,8 @@ class JaxDataLoader(JaxLoaderBase):
 
     def __init__(self, reader, batch_size=1, shuffling_queue_capacity=0,
                  transform_fn=None, drop_last=False, seed=None,
-                 inmemory_cache_all=False, pad_spec=None):
+                 inmemory_cache_all=False, pad_spec=None, device_decode=True,
+                 prefetch_depth=None):
         super(JaxDataLoader, self).__init__(reader)
         # NGram rows are {offset: namedtuple} windows; they batch through
         # per-timestep collation into {offset: dict-of-column-arrays} —
@@ -418,6 +474,42 @@ class JaxDataLoader(JaxLoaderBase):
             defer = getattr(reader, '_defer_e2e_to_loader', None)
             if defer is not None:
                 defer()
+        #: Depth of the :func:`prefetch_to_device` / :func:`prefetch_batches`
+        #: window :meth:`iter_prefetched` uses (docs/readahead.md knob note).
+        self.prefetch_depth = resolve_prefetch_depth(prefetch_depth)
+        # -- device-side decode (docs/decode.md "Device-side decode") ----------
+        #: name -> DeviceColumnPlan claimed from a bytes-through reader; the
+        #: loader decodes these raw (n, stride) uint8 columns under jax.jit
+        #: at batch delivery (fused with any device-flagged TransformSpec).
+        #: ``device_decode=False`` leaves the claim to an outer component
+        #: (ShardedJaxLoader decodes post-staging on the global arrays).
+        self._device_plans = {}
+        self._device_transform_spec = None
+        self._device_fused_fn = None
+        if device_decode:
+            claim = getattr(reader, '_defer_device_decode_to_loader', None)
+            if claim is not None and getattr(reader, 'device_decode_plans',
+                                             None):
+                self._device_plans, self._device_transform_spec = claim()
+
+    def _decode_on_device(self, batch):
+        """Run the jitted decode (+ fused device ``TransformSpec``) over a
+        bytes-through batch's device-compatible columns; host-only values
+        merge back untouched."""
+        from petastorm_tpu.ops.decode import (build_fused_infeed,
+                                              split_device_columns)
+        if self._device_fused_fn is None:
+            self._device_fused_fn = build_fused_infeed(
+                self._device_plans, self._device_transform_spec)
+        device_cols, host_cols = split_device_columns(batch,
+                                                      self._device_plans)
+        out = dict(self._device_fused_fn(device_cols))
+        out.update(host_cols)
+        planned = [n for n in self._device_plans if n in device_cols]
+        if planned and self.stats is not None:
+            rows = int(device_cols[planned[0]].shape[0])
+            self.stats.add('rows_decoded_device', rows * len(planned))
+        return out
 
     def _cache_hot(self):
         return self._cache_complete
@@ -455,6 +547,11 @@ class JaxDataLoader(JaxLoaderBase):
             sources = (batch.pop(LINEAGE_COLUMN, None)
                        if self._lineage_on and isinstance(batch, dict)
                        else None)
+            if self._device_plans and isinstance(batch, dict):
+                # decode raw planned columns (and run the fused device
+                # TransformSpec) as ONE jitted program, before any host
+                # pad/transform sees the batch
+                batch = self._decode_on_device(batch)
             if self.pad_spec:
                 batch = pad_ragged_batch(batch, self.pad_spec)
             if self.transform_fn is not None:
@@ -692,7 +789,7 @@ class ShardedJaxLoader(JaxLoaderBase):
 
     def __init__(self, reader, mesh, local_batch_size, batch_axis='data',
                  shuffling_queue_capacity=0, transform_fn=None, seed=None,
-                 inmemory_cache_all=False, pad_spec=None):
+                 inmemory_cache_all=False, pad_spec=None, prefetch_depth=None):
         super(ShardedJaxLoader, self).__init__(reader)
         from jax.sharding import NamedSharding, PartitionSpec
         # NGram batches are nested {offset: {field: array}}; each timestep's
@@ -703,14 +800,30 @@ class ShardedJaxLoader(JaxLoaderBase):
         self.batch_axis = batch_axis
         require_single_bucket_pad_spec(validate_pad_spec(pad_spec),
                                        'ShardedJaxLoader')
+        # device_decode=False: the inner loader must NOT decode the raw
+        # bytes-through columns pre-staging — this loader claims them below
+        # and decodes post-staging, jitted over the GLOBAL sharded arrays,
+        # so decode work shards along the batch axis with the data
         self._loader = JaxDataLoader(
             reader, batch_size=local_batch_size,
             shuffling_queue_capacity=shuffling_queue_capacity,
             transform_fn=transform_fn, drop_last=True, seed=seed,
-            inmemory_cache_all=inmemory_cache_all, pad_spec=pad_spec)
+            inmemory_cache_all=inmemory_cache_all, pad_spec=pad_spec,
+            device_decode=False, prefetch_depth=prefetch_depth)
         self._pspec = PartitionSpec(batch_axis)
         self._named_sharding = NamedSharding(mesh, self._pspec)
         self.stats = self._loader.stats
+        self.prefetch_depth = self._loader.prefetch_depth
+        # -- device-side decode (docs/decode.md "Device-side decode") ----------
+        self._device_plans = {}
+        self._device_fused_fn = None
+        claim = getattr(reader, '_defer_device_decode_to_loader', None)
+        if claim is not None and getattr(reader, 'device_decode_plans', None):
+            plans, device_spec = claim()
+            if plans:
+                from petastorm_tpu.ops.decode import build_fused_infeed
+                self._device_plans = plans
+                self._device_fused_fn = build_fused_infeed(plans, device_spec)
 
     def _cache_hot(self):
         return self._loader._cache_hot()
@@ -754,8 +867,15 @@ class ShardedJaxLoader(JaxLoaderBase):
                                             stats=stats, tracer=tracer)
                        for off, cols in batch.items()}
             else:
+                if self._device_plans and stats is not None:
+                    planned = [n for n in self._device_plans if n in batch]
+                    if planned:
+                        stats.add('rows_decoded_device',
+                                  int(batch[planned[0]].shape[0])
+                                  * len(planned))
                 yield stage_to_global(batch, self._named_sharding, stats=stats,
-                                      tracer=tracer)
+                                      tracer=tracer,
+                                      fused_fn=self._device_fused_fn)
 
 
 def _all_processes_ready(local_ready: bool) -> bool:
@@ -769,14 +889,18 @@ def _all_processes_ready(local_ready: bool) -> bool:
     return bool(np.asarray(flags).min())
 
 
-def stage_to_global(batch, named_sharding, stats=None, tracer=None):
+def stage_to_global(batch, named_sharding, stats=None, tracer=None,
+                    fused_fn=None):
     """Assemble a host batch dict into global ``jax.Array``s over
     ``named_sharding``; device-incompatible (string/object) columns ride
     under ``batch['_host']`` untouched — the single definition of the
     'what can live in HBM' split. ``stats`` (a ``ReaderStats``) accumulates
     the assembly wall time as ``device_stage_s``; ``tracer`` (a
     :class:`~petastorm_tpu.tracing.Tracer`) records it as a ``device_stage``
-    span."""
+    span. ``fused_fn`` (an ``ops.decode.build_fused_infeed`` program) runs
+    over the assembled device dict — bytes-through decode plus any device
+    ``TransformSpec``, jitted over the GLOBAL sharded arrays so the work
+    shards along the batch axis with the data."""
     import jax
     timed = stats is not None or tracer is not None
     start = time.perf_counter() if timed else 0.0
@@ -792,6 +916,8 @@ def stage_to_global(batch, named_sharding, stats=None, tracer=None):
                 named_sharding, value)
         else:
             host[name] = value
+    if fused_fn is not None and device:
+        device = dict(fused_fn(device))
     if host:
         device['_host'] = host
     if timed:
@@ -848,6 +974,7 @@ def infeed_diagnosis(snapshot: dict, heartbeats=None,
     from petastorm_tpu.health import (DEFAULT_STALL_AFTER_S,
                                       bottleneck_signals, classify_pipeline)
     from petastorm_tpu.workers.stats import (batched_decode_fraction,
+                                             device_decode_fraction,
                                              readahead_hit_rate,
                                              recommend_io_readahead)
     signals = bottleneck_signals(snapshot)
@@ -864,6 +991,9 @@ def infeed_diagnosis(snapshot: dict, heartbeats=None,
         'rows_decoded_batched': snapshot.get('rows_decoded_batched', 0),
         'rows_decoded_percell': snapshot.get('rows_decoded_percell', 0),
         'batched_decode_fraction': batched_decode_fraction(snapshot),
+        'rows_decoded_device': snapshot.get('rows_decoded_device', 0),
+        'bytes_shipped_raw': snapshot.get('bytes_shipped_raw', 0),
+        'device_decode_fraction': device_decode_fraction(snapshot),
         'queue_wait_p50_s': round(snapshot.get('queue_wait_p50_s', 0.0), 6),
         'queue_wait_p99_s': round(snapshot.get('queue_wait_p99_s', 0.0), 6),
         'e2e_latency_p99_s': round(snapshot.get('e2e_latency_p99_s', 0.0), 6),
@@ -898,23 +1028,30 @@ def infeed_diagnosis(snapshot: dict, heartbeats=None,
 def make_jax_loader(reader, batch_size=1, mesh=None, batch_axis='data',
                     shuffling_queue_capacity=0, transform_fn=None,
                     drop_last=False, seed=None, inmemory_cache_all=False,
-                    pad_spec=None):
+                    pad_spec=None, device_decode=True, prefetch_depth=None):
     """Factory: plain host loader when ``mesh is None``, else a sharded loader.
 
     With a mesh, ``batch_size`` is the **per-process** batch size; the global
     logical batch is ``batch_size * jax.process_count()``.
+
+    ``device_decode=False`` opts the host loader out of claiming a
+    bytes-through reader's raw columns (the reader then host-decodes them,
+    keeping its yield contract). ``prefetch_depth`` sets the loaders'
+    :meth:`~JaxLoaderBase.iter_prefetched` lookahead window (default: the
+    ``PETASTORM_TPU_PREFETCH_DEPTH`` env var, else 2 — docs/readahead.md).
     """
     if mesh is None:
         return JaxDataLoader(reader, batch_size=batch_size,
                              shuffling_queue_capacity=shuffling_queue_capacity,
                              transform_fn=transform_fn, drop_last=drop_last,
                              seed=seed, inmemory_cache_all=inmemory_cache_all,
-                             pad_spec=pad_spec)
+                             pad_spec=pad_spec, device_decode=device_decode,
+                             prefetch_depth=prefetch_depth)
     return ShardedJaxLoader(reader, mesh, batch_size, batch_axis=batch_axis,
                             shuffling_queue_capacity=shuffling_queue_capacity,
                             transform_fn=transform_fn, seed=seed,
                             inmemory_cache_all=inmemory_cache_all,
-                            pad_spec=pad_spec)
+                            pad_spec=pad_spec, prefetch_depth=prefetch_depth)
 
 
 def epoch_cache_on_device(loader, sharding=None):
@@ -953,7 +1090,7 @@ def epoch_cache_on_device(loader, sharding=None):
             yield batch
 
 
-def prefetch_batches(iterator, size=2, health=None):
+def prefetch_batches(iterator, size=None, health=None):
     """Host-side lookahead WITHOUT device staging: a background thread keeps
     up to ``size`` numpy batches ready; the jitted step's own call performs
     the host→device transfer. ``health`` (a
@@ -969,11 +1106,12 @@ def prefetch_batches(iterator, size=2, health=None):
     dispatch. Measured on a v5e LM bench (64×257 int32 batches, ~1ms steps):
     86-90% infeed overlap via ``prefetch_to_device`` vs ~99% via
     ``prefetch_batches``."""
-    return _pipeline(iterator, size, lambda batch: batch, health=health)
+    return _pipeline(iterator, resolve_prefetch_depth(size),
+                     lambda batch: batch, health=health)
 
 
-def prefetch_to_device(iterator, size=2, sharding=None, stats=None,
-                       tracer=None, health=None):
+def prefetch_to_device(iterator, size=None, sharding=None, stats=None,
+                       tracer=None, health=None, fused_fn=None):
     """Double-buffered host→device prefetch.
 
     Stages up to ``size`` batches ahead of the consumer on a background thread
@@ -996,8 +1134,15 @@ def prefetch_to_device(iterator, size=2, sharding=None, stats=None,
         (e.g. ``reader.health`` / ``loader.health``); the prefetch thread
         publishes a ``loader-prefetch`` heartbeat entity so the watchdog can
         tell a wedged device transfer from a starving reader.
+    :param fused_fn: optional ``ops.decode.build_fused_infeed`` program run
+        over each staged batch's device-compatible columns on the prefetch
+        thread — bytes-through decode (+ device ``TransformSpec``) overlaps
+        the consumer's compute exactly like the transfer it rides with.
+    :param size: lookahead depth; ``None`` resolves the loader knob chain
+        (``PETASTORM_TPU_PREFETCH_DEPTH``, else 2 — docs/readahead.md).
     """
     import jax
+    size = resolve_prefetch_depth(size)
 
     def put(batch):
         # _is_device_compatible reads dtype via getattr: global jax.Arrays must
@@ -1013,6 +1158,14 @@ def prefetch_to_device(iterator, size=2, sharding=None, stats=None,
             staged = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, sharding) if _is_device_compatible(x) else x,
                 batch)
+        if fused_fn is not None and isinstance(staged, dict):
+            host = {k: v for k, v in staged.items()
+                    if not _is_device_compatible(v)}
+            dev = {k: v for k, v in staged.items()
+                   if _is_device_compatible(v)}
+            if dev:
+                staged = dict(fused_fn(dev))
+                staged.update(host)
         if timed:
             elapsed = time.perf_counter() - start
             if stats is not None:

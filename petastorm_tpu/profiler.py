@@ -68,11 +68,18 @@ CALIBRATION_DIR_ENV_VAR = 'PETASTORM_TPU_CALIBRATION_DIR'
 #: taken through the SAME decode path. Bumped to 2 when the decode probe
 #: moved onto the row-group-vectorized path (docs/decode.md) — a per-cell
 #: ceiling served against batched measurements would misreport
-#: roofline_fraction by up to the batched speedup. Artifacts from older
-#: probe versions (or with no version at all) read as a cache miss.
-PROBE_SCHEMA_VERSION = 2
+#: roofline_fraction by up to the batched speedup. Bumped to 3 when the
+#: device-decode probe family landed: per-codec entries now record which
+#: path (``host-batched`` / ``per-cell`` / ``device``) produced each
+#: ceiling, and ``device_decode`` / ``ingest`` ceilings joined the
+#: artifact — pre-upgrade artifacts carry neither and must not judge
+#: device measurements, so they read as a cache miss.
+PROBE_SCHEMA_VERSION = 3
 
 #: Pipeline stages a ceiling is calibrated for, in pipeline order.
+#: ``device_decode`` (jitted bytes-through decode) and ``ingest`` (raw
+#: payload host→device transfer) are probe-only ceilings consumed by the
+#: device-decode benchmark gate; they never bind the host span attribution.
 CEILING_STAGES = ('io', 'decode', 'serialize', 'device_stage')
 
 #: Span name -> attribution stage. Spans whose name is not listed keep their
@@ -318,10 +325,12 @@ def _probe_decode(filesystem, sampled, schema) -> dict:
                 label = '{}({})'.format(label, str(image_format).lstrip('.'))
             entry = per_codec.setdefault(label, {'rows': 0, 'seconds': 0.0,
                                                  'decoded_bytes': 0,
-                                                 'batched_rows': 0})
+                                                 'batched_rows': 0,
+                                                 'percell_rows': 0})
             entry['rows'] += n
             entry['seconds'] += elapsed
             entry['batched_rows'] += path_counts['batched']
+            entry['percell_rows'] += path_counts['percell']
             nbytes = getattr(out, 'nbytes', 0)
             entry['decoded_bytes'] += int(nbytes)
             decoded_bytes += int(nbytes)
@@ -332,6 +341,16 @@ def _probe_decode(filesystem, sampled, schema) -> dict:
                                    / entry['seconds'], 1)
                              if entry['seconds'] else None)
         entry['seconds'] = round(entry['seconds'], 4)
+        # which path produced this ceiling (probe_version 3): a device
+        # measurement judged against a per-cell ceiling — or vice versa —
+        # would mis-grade by the whole path speedup
+        if entry['batched_rows'] >= entry['percell_rows'] \
+                and entry['batched_rows']:
+            entry['path'] = 'host-batched'
+        elif entry['percell_rows']:
+            entry['path'] = 'per-cell'
+        else:
+            entry['path'] = 'host-native'
     return {
         'rows': rows,
         'seconds': round(total_s, 4),
@@ -427,6 +446,108 @@ def _probe_device_stage(columns: dict, rows: int) -> Optional[dict]:
     }
 
 
+def _raw_sample_columns(filesystem, sampled, schema) -> Optional[Tuple]:
+    """``(plans, raw_columns, rows)`` for the bytes-through probes: the
+    device-decode plans of this view plus one sampled row group's raw
+    ``(n, stride)`` uint8 grids, or ``None`` when nothing plans (host-matrix
+    store, kill switch off, no jax backend)."""
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.ops.decode import (plan_device_decode, raw_column_view,
+                                          repack_to_raw)
+    from petastorm_tpu.readers.columnar_worker import _column_to_numpy
+    plans, _ = plan_device_decode(schema)
+    if not plans:
+        return None
+    piece = sampled[0]
+    handle = filesystem.open(piece.path, 'rb')
+    try:
+        table = pq.ParquetFile(handle).read_row_group(piece.row_group)
+    finally:
+        handle.close()
+    raw_columns = {}
+    for name, plan in plans.items():
+        if name not in table.column_names:
+            continue
+        raw = raw_column_view(table.column(name), plan)
+        if raw is None:
+            decoded = _column_to_numpy(table.column(name),
+                                       schema.fields[name], None)
+            raw = repack_to_raw(plan, decoded)
+        raw_columns[name] = raw
+    if not raw_columns:
+        return None
+    return plans, raw_columns, table.num_rows
+
+
+def _probe_device_decode(plans, raw_columns, rows) -> Optional[dict]:
+    """The jitted bytes-through decode ceiling (docs/decode.md): header-strip
+    + bitcast + reshape under ``jax.jit`` over resident raw grids — compute
+    only, no transfer (the :func:`_probe_ingest` twin measures that). The
+    pair answers BENCH_r13's open question quantitatively: once decode moves
+    off the host, which wall is next — device decode FLOPs or the PCIe/ICI
+    ingest link. ``None`` when no jax backend initializes."""
+    try:
+        import jax
+
+        from petastorm_tpu.ops.decode import build_fused_infeed
+        fused = build_fused_infeed(plans)
+        staged = {name: jax.device_put(col)
+                  for name, col in raw_columns.items()}
+        jax.block_until_ready(fused(staged))          # warm + compile
+        decoded_bytes = sum(rows * plans[name].cell_nbytes
+                            for name in raw_columns)
+        elapsed = None
+        for _ in range(PROBE_REPS):
+            start = time.perf_counter()
+            jax.block_until_ready(fused(staged))
+            took = time.perf_counter() - start
+            elapsed = took if elapsed is None else min(elapsed, took)
+    except Exception as e:  # noqa: BLE001 - probe must degrade, not raise
+        logger.debug('device-decode probe unavailable: %r', e)
+        return None
+    return {
+        'rows': rows,
+        'columns': sorted(raw_columns),
+        'path': 'device',
+        'decoded_bytes': int(decoded_bytes),
+        'seconds': round(elapsed, 6),
+        'rows_per_s': round(rows / elapsed, 1) if elapsed else None,
+        'mb_per_s': round(decoded_bytes / _MB / elapsed, 1)
+        if elapsed else None,
+    }
+
+
+def _probe_ingest(raw_columns, rows) -> Optional[dict]:
+    """Raw-payload host→device transfer ceiling: ``jax.device_put`` of the
+    exact ``(n, stride)`` uint8 grids a bytes-through reader ships — the
+    PCIe/ICI ingest bandwidth PAPER §5.8 names as the intended pipeline
+    ceiling. ``None`` when no jax backend initializes."""
+    try:
+        import jax
+        payload_bytes = sum(col.nbytes for col in raw_columns.values())
+        jax.block_until_ready(
+            {k: jax.device_put(v) for k, v in raw_columns.items()})  # warm
+        elapsed = None
+        for _ in range(PROBE_REPS):
+            start = time.perf_counter()
+            jax.block_until_ready(
+                {k: jax.device_put(v) for k, v in raw_columns.items()})
+            took = time.perf_counter() - start
+            elapsed = took if elapsed is None else min(elapsed, took)
+    except Exception as e:  # noqa: BLE001 - probe must degrade, not raise
+        logger.debug('ingest probe unavailable: %r', e)
+        return None
+    return {
+        'rows': rows,
+        'payload_bytes': int(payload_bytes),
+        'seconds': round(elapsed, 6),
+        'rows_per_s': round(rows / elapsed, 1) if elapsed else None,
+        'mb_per_s': round(payload_bytes / _MB / elapsed, 1)
+        if elapsed else None,
+    }
+
+
 def calibrate(filesystem, dataset_path, pieces, schema,
               sample_row_groups: int = 3,
               cache_dir: Optional[str] = None,
@@ -442,6 +563,14 @@ def calibrate(filesystem, dataset_path, pieces, schema,
     columns, sample_rows = _decode_sample_columns(filesystem, sampled, schema)
     serialize = _probe_serialize(columns, sample_rows)
     device = _probe_device_stage(columns, sample_rows)
+    # bytes-through probe family (docs/decode.md "Device-side decode"):
+    # measured only when this view actually plans device columns
+    raw_sample = _raw_sample_columns(filesystem, sampled, schema)
+    device_decode = ingest = None
+    if raw_sample is not None:
+        plans, raw_columns, raw_rows = raw_sample
+        device_decode = _probe_device_decode(plans, raw_columns, raw_rows)
+        ingest = _probe_ingest(raw_columns, raw_rows)
     total_rows = sum(max(0, p.num_rows) for p in pieces)
     # the faster of the two open modes is the storage ceiling: the workers
     # pick per filesystem, and the roofline should not punish a dataset for
@@ -454,6 +583,9 @@ def calibrate(filesystem, dataset_path, pieces, schema,
         'decode': decode.get('rows_per_s'),
         'serialize': serialize.get('rows_per_s'),
         'device_stage': device.get('rows_per_s') if device else None,
+        'device_decode': (device_decode.get('rows_per_s')
+                          if device_decode else None),
+        'ingest': ingest.get('rows_per_s') if ingest else None,
     }
     calibration = {
         'kind': 'petastorm_tpu_roofline_calibration',
@@ -474,6 +606,8 @@ def calibrate(filesystem, dataset_path, pieces, schema,
             'decode': decode,
             'serialize': serialize,
             'device_stage': device,
+            'device_decode': device_decode,
+            'ingest': ingest,
         },
         'ceilings': ceilings,
     }

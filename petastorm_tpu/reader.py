@@ -678,6 +678,37 @@ class Reader:
         if autotune_options is not None and not autotune_active:
             logger.warning('autotune disabled: the %s pool has no live '
                            'actuators', self._pool_type)
+
+        # -- device-decode planning (docs/decode.md "Device-side decode") ------
+        from petastorm_tpu.ops.decode import plan_device_decode
+        device_decode_plans, device_decode_declined = plan_device_decode(
+            view_schema,
+            has_predicate=(worker_predicate is not None
+                           or filters_predicate is not None),
+            has_ngram=self.ngram is not None,
+            decode_hints=decode_hints,
+            transform_spec=transform_spec,
+            transformed_schema=transformed_schema,
+            batched_output=self._is_batched_reader,
+            tolerant_decode=(on_decode_error != 'raise'),
+            worker_supported=getattr(worker_class, 'supports_device_decode',
+                                     False))
+        #: name -> :class:`~petastorm_tpu.ops.decode.DeviceColumnPlan` for the
+        #: columns workers ship raw (bytes-through); empty when the whole
+        #: reader declined to the host decode matrix.
+        self.device_decode_plans = device_decode_plans
+        #: column name (or ``'*'`` for whole-reader reasons) -> why the device
+        #: path declined; surfaced by ``infeed_diagnosis`` for triage.
+        self.device_decode_declined = device_decode_declined
+        # a device-flagged TransformSpec fuses into the loader's jitted
+        # decode program instead of running on CPU workers; the worker-side
+        # spec is nulled so the transform runs exactly once
+        self._device_transform_spec = (
+            transform_spec if (device_decode_plans and transform_spec is not None
+                               and transform_spec.device) else None)
+        self._device_decode_deferred = False
+        worker_transform_spec = (None if self._device_transform_spec is not None
+                                 else transform_spec)
         worker_args = {
             'trace': tracer is not None,
             'health': self.health.enabled,
@@ -697,9 +728,10 @@ class Reader:
             'ngram': self.ngram,
             'split_pieces': pieces,
             'local_cache': cache,
-            'transform_spec': transform_spec,
+            'transform_spec': worker_transform_spec,
             'transformed_schema': transformed_schema,
             'decode_hints': decode_hints,
+            'device_decode_plans': device_decode_plans,
             'io_readahead': io_readahead,
         }
         self._worker_args = worker_args
@@ -914,13 +946,50 @@ class Reader:
                 if ts is not None:
                     self._pool.stats.record_latency(
                         'e2e_batch', time.perf_counter() - ts)
+        if self.device_decode_plans and not self._device_decode_deferred:
+            # no loader claimed the raw columns: keep the "reader yields
+            # decoded batches" contract by decoding on the host here (the
+            # vectorized reference path, counted as batched host decode)
+            row = self._host_decode_raw(row)
         return row
+
+    def _host_decode_raw(self, batch):
+        """Host-decode a bytes-through batch's raw planned columns (and run
+        a device-flagged transform on the host) — the fallback consumer path
+        when :meth:`_defer_device_decode_to_loader` was never called."""
+        from petastorm_tpu.ops.decode import decode_raw_host
+        updates = {}
+        rows = 0
+        for name, plan in self.device_decode_plans.items():
+            col = getattr(batch, name, None)
+            if col is None:
+                continue
+            updates[name] = decode_raw_host(plan, col)
+            rows = max(rows, len(col))
+        if updates:
+            batch = batch._replace(**updates)
+            self._pool.stats.add('rows_decoded_batched', rows)
+        if self._device_transform_spec is not None:
+            from petastorm_tpu.transform import apply_columnar_transform
+            columns = apply_columnar_transform(self._device_transform_spec,
+                                               self.schema, batch._asdict())
+            batch = batch._replace(**columns)
+        return batch
 
     def _defer_e2e_to_loader(self):
         """Called by ``JaxDataLoader`` when it takes over end-to-end latency
         recording at its own (later) batch-delivery point — the reader's
         per-item recording stops so each delivered unit is observed once."""
         self._e2e_live = False
+
+    def _defer_device_decode_to_loader(self):
+        """Called by ``JaxDataLoader`` (and the sharded staging path) when it
+        claims the bytes-through columns: raw ``(n, stride)`` uint8 grids pass
+        through :meth:`__next__` undecoded and the loader decodes them under
+        ``jax.jit`` (fused with any device ``TransformSpec``). Returns
+        ``(plans, device_transform_spec)``."""
+        self._device_decode_deferred = True
+        return self.device_decode_plans, self._device_transform_spec
 
     def next(self):
         return self.__next__()
@@ -1052,6 +1121,13 @@ class Reader:
         emitter and the debug endpoint's ``/metrics`` serve, so scrapes
         show %-of-ceiling, not just raw samples/s."""
         snapshot = self._pool.stats.snapshot()
+        # derived decode-path mix (docs/decode.md): scrapes and flight
+        # records should answer "is the device path actually carrying the
+        # decode" without re-deriving it from raw counters
+        from petastorm_tpu.workers.stats import device_decode_fraction
+        fraction = device_decode_fraction(snapshot)
+        if fraction is not None:
+            snapshot['device_decode_fraction'] = fraction
         if self._roofline_gauges:
             snapshot.update(self._roofline_gauges)
         if self._controller is not None:

@@ -403,6 +403,12 @@ class ColumnarWorker(ParquetPieceWorker):
     """Processes ventilated items into published dicts of decoded numpy
     column arrays."""
 
+    #: The columnar publish path ships dicts of per-column arrays, so a
+    #: device-planned column can travel as its raw ``(n, stride)`` uint8
+    #: grid (docs/decode.md "Device-side decode"). Row/arrow-batch workers
+    #: leave this unset and the reader's planner declines for them.
+    supports_device_decode = True
+
     def __init__(self, worker_id, publish_func, args):
         super().__init__(worker_id, publish_func, args)
         # the spec is fixed for the worker's lifetime: fingerprint once, not

@@ -154,6 +154,10 @@ class ParquetPieceWorker(WorkerBase):
         # row-group-vectorized codec decode (docs/decode.md); the env kill
         # switch is read once per worker, never per cell
         self._batched_decode = batched_decode_enabled()
+        # bytes-through plans (docs/decode.md "Device-side decode"): planned
+        # columns skip host decode and ship as raw (n, stride) uint8 grids.
+        # The reader plans once; workers only execute the shipped plan.
+        self._device_plans = args.get('device_decode_plans') or {}
         # pre_buffer coalesces a row group's column chunks into few large
         # ranged reads — the right shape for object stores (GCS/S3/HDFS),
         # pure overhead for local mmap-fast files
@@ -194,6 +198,13 @@ class ParquetPieceWorker(WorkerBase):
                 repr(sorted((k, sorted(v.items()))
                             for k, v in self._decode_hints.items())).encode()
             ).hexdigest()[:12]
+        # a bytes-through reader caches RAW (n, stride) grids where a host
+        # reader caches decoded arrays — the representations must never be
+        # served across that boundary (see docs/cache.md key schema)
+        self._device_plans_digest = ''
+        if self._device_plans:
+            self._device_plans_digest = ':dd' + hashlib.md5(
+                ','.join(sorted(self._device_plans)).encode()).hexdigest()[:8]
         # -- readahead (see petastorm_tpu/readers/readahead.py) ----------------
         self._readahead = None
         self._prefetch_files: Optional[FileHandleCache] = None
@@ -433,11 +444,29 @@ class ParquetPieceWorker(WorkerBase):
         start = time.perf_counter()
         out = {}
         path_counts = {'batched': 0, 'percell': 0}
+        raw_bytes = 0
         for name in names:
             if name not in table.column_names:
                 continue
             field = self._full_schema.fields[name]
             column = table.column(name)
+            plan = self._device_plans.get(name)
+            if plan is not None:
+                # bytes-through: ship the raw payload grid; the loader (or
+                # the reader's host fallback) decodes. A chunk that drifted
+                # from the pinned layout host-decodes and repacks so the
+                # column's representation stays uniform — never an error.
+                from petastorm_tpu.ops.decode import (raw_column_view,
+                                                      repack_to_raw)
+                raw = raw_column_view(column, plan)
+                if raw is None:
+                    decoded = _column_to_numpy(column, field, None,
+                                               batched=self._batched_decode,
+                                               path_counts=path_counts)
+                    raw = repack_to_raw(plan, decoded)
+                out[name] = raw
+                raw_bytes += raw.nbytes
+                continue
             on_cell_error = None
             if error_sink is not None and field.codec is not None:
                 def on_cell_error(row, exc, _name=name):
@@ -460,6 +489,8 @@ class ParquetPieceWorker(WorkerBase):
             self.record_count('rows_decoded_batched', path_counts['batched'])
         if path_counts['percell']:
             self.record_count('rows_decoded_percell', path_counts['percell'])
+        if raw_bytes:
+            self.record_count('bytes_shipped_raw', raw_bytes)
         elapsed = time.perf_counter() - start
         self.record_latency('decode', elapsed)
         self.record_span('decode_columns', 'decode', start, elapsed)
@@ -638,6 +669,7 @@ class ParquetPieceWorker(WorkerBase):
         # view (host-wide shared tiers serve MANY readers; see docs/cache.md
         # for the full key schema) — otherwise a reader with different hints
         # or fields would be served wrong payloads
-        return '{}:{}:{}:{}:{}{}'.format(
+        return '{}:{}:{}:{}:{}{}{}'.format(
             prefix, self._dataset_path_digest, self._view_digest,
-            piece.path, piece.row_group, self._decode_hints_digest)
+            piece.path, piece.row_group, self._decode_hints_digest,
+            self._device_plans_digest)

@@ -63,6 +63,7 @@ DEFAULT_TARGET_MODULES = (
     'petastorm_tpu.workers.ventilator',
     'petastorm_tpu.readers.readahead',
     'petastorm_tpu.readers.piece_worker',
+    'petastorm_tpu.ops.decode',
 )
 
 

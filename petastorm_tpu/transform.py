@@ -28,13 +28,24 @@ class TransformSpec:
     :param selected_fields: if set, the post-transform schema keeps exactly these
         fields. Mutually exclusive with ``removed_fields``
         (reference ``transform.py:53-57``).
+    :param device: declare ``func`` jit-compatible (jnp ops over a dict of
+        batch columns, no Python side effects). A device spec is **fused
+        into the jitted device-decode program** on the staging stream
+        (``ops.decode.build_fused_infeed``) instead of running on CPU
+        workers — the ``is_batched_jax`` promise above, made real. When the
+        reader's columns are not device-eligible (``docs/decode.md``), a
+        device spec still runs on the host over the same columnar dict
+        (jnp ops accept numpy arrays), so results do not depend on
+        eligibility.
     """
 
     def __init__(self, func: Optional[Callable] = None,
                  edit_fields: Optional[List] = None,
                  removed_fields: Optional[List[str]] = None,
-                 selected_fields: Optional[List[str]] = None):
+                 selected_fields: Optional[List[str]] = None,
+                 device: bool = False):
         self.func = func
+        self.device = bool(device)
         self.edit_fields = [self._as_field(f) for f in (edit_fields or [])]
         self.removed_fields = list(removed_fields or [])
         self.selected_fields = list(selected_fields) if selected_fields is not None else None

@@ -55,6 +55,12 @@ COUNTERS = (
     'rows_decoded_percell',  # codec column cells that fell back to the
                              # per-cell loop (wildcard shapes, nulls,
                              # decode hints, punted/corrupt chunks)
+    'rows_decoded_device',   # codec column cells decoded on-device under
+                             # jax.jit from bytes-through raw payloads
+                             # (ops/decode.py, docs/decode.md)
+    'bytes_shipped_raw',     # raw (undecoded) payload bytes workers shipped
+                             # for device-planned columns instead of
+                             # host-decoding them
     'shared_hits',       # row groups served from the host-wide shared cache
     'shared_misses',     # shared-cache lookups that fell through to io+decode
     'shared_evictions',  # shared-cache segments evicted/spilled (this reader)
@@ -300,6 +306,22 @@ def batched_decode_fraction(snapshot: dict):
     if not total:
         return None
     return round(batched / total, 4)
+
+
+def device_decode_fraction(snapshot: dict):
+    """Fraction of codec column cells decoded on-device under ``jax.jit``
+    (``None`` when no codec cells were decoded anywhere — same contract as
+    :func:`batched_decode_fraction`). A bytes-through epoch on an all-
+    eligible view reads ≈1.0; anything lower means columns declined to the
+    host matrix (``docs/decode.md`` has the eligibility table) or raw
+    chunks failed validation and were host-decoded + repacked."""
+    device = snapshot.get('rows_decoded_device', 0)
+    host = (snapshot.get('rows_decoded_batched', 0)
+            + snapshot.get('rows_decoded_percell', 0))
+    total = device + host
+    if not total:
+        return None
+    return round(device / total, 4)
 
 
 def recommend_io_readahead(snapshot: dict, max_depth: int = 8) -> int:
