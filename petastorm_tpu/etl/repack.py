@@ -16,14 +16,34 @@ regression is bounded while the decode stays a header-strip + bitcast.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import logging
+from typing import Dict, List, Optional, Tuple
 
 from petastorm_tpu.codecs import CompressedNdarrayCodec, NdarrayCodec
 from petastorm_tpu.unischema import Unischema, UnischemaField
 
+logger = logging.getLogger(__name__)
+
+
+def still_ineligible_after_repack(schema: Unischema,
+                                  repacked: List[str]) -> Dict[str, str]:
+    """``{name: reason}`` for repacked fields that STILL decline device
+    decode after the codec swap — static per-field decliners the repack
+    cannot fix (``nullable=True``, wildcard shapes, non-numeric or
+    big-endian dtypes). Such a field decodes on the host batched path
+    either way; the repack buys it nothing."""
+    out: Dict[str, str] = {}
+    for name in repacked:
+        field = schema.fields[name]
+        reason = field.codec.device_decode_unsupported_reason(field)
+        if reason:
+            out[name] = reason
+    return out
+
 
 def repack_schema(schema: Unischema,
-                  fields: Optional[List[str]] = None) -> Unischema:
+                  fields: Optional[List[str]] = None
+                  ) -> Tuple[Unischema, List[str]]:
     """``(post_repack_schema, repacked_names)``: every
     :class:`~petastorm_tpu.codecs.CompressedNdarrayCodec` field (or just
     the named ``fields``) re-declared with
@@ -51,7 +71,14 @@ def repack_schema(schema: Unischema,
             repacked.append(name)
         else:
             out_fields.append(field)
-    return Unischema(schema._name + '_repacked', out_fields), repacked
+    out_schema = Unischema(schema._name + '_repacked', out_fields)
+    for name, reason in still_ineligible_after_repack(out_schema,
+                                                      repacked).items():
+        logger.warning(
+            'repack_schema: field %r stays device-INELIGIBLE after the '
+            'codec swap (%s); the repack pays zlib up front but the column '
+            'still decodes on the host matrix', name, reason)
+    return out_schema, repacked
 
 
 def repack_to_ndarray_codec(source_url: str, output_url: str,
@@ -62,7 +89,9 @@ def repack_to_ndarray_codec(source_url: str, output_url: str,
     """Materialize a device-decode-eligible copy of ``source_url`` at
     ``output_url``: compressed ndarray columns inflate once here and store
     as raw ``np.save`` payloads. Returns a summary dict
-    (``rows``, ``repacked_fields``, ``output_url``).
+    (``rows``, ``repacked_fields``, ``output_url``, plus
+    ``still_ineligible`` — repacked fields that remain device-ineligible
+    for reasons the codec swap cannot fix, e.g. ``nullable=True``).
 
     The copy streams through a columnar reader (decode happens on the
     reader's host matrix — this tool never needs an accelerator), so
@@ -90,4 +119,6 @@ def repack_to_ndarray_codec(source_url: str, output_url: str,
                                       for name, col in columns.items()})
                 rows += n
     return {'rows': rows, 'repacked_fields': repacked,
-            'output_url': output_url}
+            'output_url': output_url,
+            'still_ineligible': still_ineligible_after_repack(out_schema,
+                                                              repacked)}

@@ -501,8 +501,9 @@ class JaxDataLoader(JaxLoaderBase):
         if self._device_fused_fn is None:
             self._device_fused_fn = build_fused_infeed(
                 self._device_plans, self._device_transform_spec)
-        device_cols, host_cols = split_device_columns(batch,
-                                                      self._device_plans)
+        device_cols, host_cols = split_device_columns(
+            batch, self._device_plans,
+            include_unplanned=self._device_transform_spec is not None)
         out = dict(self._device_fused_fn(device_cols))
         out.update(host_cols)
         planned = [n for n in self._device_plans if n in device_cols]
@@ -781,6 +782,14 @@ class ShardedJaxLoader(JaxLoaderBase):
     String/object columns cannot live in HBM; they are returned under
     ``batch['_host']`` untouched.
 
+    Bytes-through readers: this loader claims the device-decode plans and
+    decodes POST-staging (jitted over the global sharded arrays) — but only
+    when no host stage needs the decoded values first. With a
+    ``transform_fn`` (or a ``pad_spec`` naming a planned column) the claim
+    is declined and the reader host-decodes, so the transform always
+    receives decoded numpy columns; fuse device-side work through a
+    ``TransformSpec(device=True)`` on the reader instead.
+
     NGram readers are supported: each step yields the nested
     ``{offset: {field: global jax.Array}}`` layout, every timestep's columns
     sharded over ``batch_axis`` at WINDOW granularity (``local_batch_size``
@@ -798,7 +807,8 @@ class ShardedJaxLoader(JaxLoaderBase):
         self._ngram = getattr(reader, 'ngram', None)
         self.mesh = mesh
         self.batch_axis = batch_axis
-        require_single_bucket_pad_spec(validate_pad_spec(pad_spec),
+        normalized_pad_spec = validate_pad_spec(pad_spec)
+        require_single_bucket_pad_spec(normalized_pad_spec,
                                        'ShardedJaxLoader')
         # device_decode=False: the inner loader must NOT decode the raw
         # bytes-through columns pre-staging — this loader claims them below
@@ -815,15 +825,39 @@ class ShardedJaxLoader(JaxLoaderBase):
         self.stats = self._loader.stats
         self.prefetch_depth = self._loader.prefetch_depth
         # -- device-side decode (docs/decode.md "Device-side decode") ----------
+        # This loader decodes POST-staging (jitted over the global sharded
+        # arrays), so the inner loader's pad/transform stages would see the
+        # raw (n, stride) uint8 grids. A host transform_fn (or a pad_spec
+        # over a planned column) needs decoded host values BEFORE staging —
+        # in that case decline the claim and let the reader host-decode,
+        # keeping the transform's decoded-numpy contract (a device=True
+        # TransformSpec still fuses into the jitted decode).
         self._device_plans = {}
         self._device_fused_fn = None
         claim = getattr(reader, '_defer_device_decode_to_loader', None)
-        if claim is not None and getattr(reader, 'device_decode_plans', None):
-            plans, device_spec = claim()
-            if plans:
-                from petastorm_tpu.ops.decode import build_fused_infeed
-                self._device_plans = plans
-                self._device_fused_fn = build_fused_infeed(plans, device_spec)
+        available_plans = getattr(reader, 'device_decode_plans', None)
+        if claim is not None and available_plans:
+            padded_planned = sorted(set(normalized_pad_spec or {})
+                                    & set(available_plans))
+            if transform_fn is not None:
+                logger.info(
+                    'ShardedJaxLoader: transform_fn needs decoded host '
+                    'columns; declining the bytes-through claim (the reader '
+                    'host-decodes). Use a TransformSpec(device=True) on the '
+                    'reader to keep decode on the accelerator.')
+            elif padded_planned:
+                logger.info(
+                    'ShardedJaxLoader: pad_spec names device-planned '
+                    'columns %s which pad before staging; declining the '
+                    'bytes-through claim (the reader host-decodes).',
+                    padded_planned)
+            else:
+                plans, device_spec = claim()
+                if plans:
+                    from petastorm_tpu.ops.decode import build_fused_infeed
+                    self._device_plans = plans
+                    self._device_fused_fn = build_fused_infeed(plans,
+                                                               device_spec)
 
     def _cache_hot(self):
         return self._loader._cache_hot()

@@ -370,15 +370,22 @@ def build_fused_infeed(plans: Dict[str, DeviceColumnPlan],
     return jax.jit(_fused)
 
 
-def split_device_columns(batch, plans: Dict[str, DeviceColumnPlan]):
-    """``(device_cols, host_cols)``: planned raw columns plus numeric
-    ndarrays go through the jitted program; object/str columns (and
-    anything jax cannot ingest) stay on the host and merge back after."""
+def split_device_columns(batch, plans: Dict[str, DeviceColumnPlan],
+                         include_unplanned: bool = False):
+    """``(device_cols, host_cols)``: planned raw columns go through the
+    jitted program; every other column stays a host numpy array, untouched
+    — a bytes-through batch must not silently turn unplanned columns into
+    immutable ``jax.Array``s (consumers mutate batches in place).
+    ``include_unplanned=True`` additionally routes unplanned numeric
+    ndarrays through the jit — required when a fused device
+    ``TransformSpec`` runs, since its func receives the full column dict;
+    object/str columns stay on the host either way."""
     device_cols, host_cols = {}, {}
     for name, value in batch.items():
         if name in plans:
             device_cols[name] = value
-        elif isinstance(value, np.ndarray) and value.dtype.kind in 'biufc':
+        elif (include_unplanned and isinstance(value, np.ndarray)
+              and value.dtype.kind in 'biufc'):
             device_cols[name] = value
         else:
             host_cols[name] = value

@@ -965,7 +965,9 @@ class Reader:
             if col is None:
                 continue
             updates[name] = decode_raw_host(plan, col)
-            rows = max(rows, len(col))
+            # per decoded COLUMN, matching the worker batched path and the
+            # device counters — the fractions divide like-for-like
+            rows += len(col)
         if updates:
             batch = batch._replace(**updates)
             self._pool.stats.add('rows_decoded_batched', rows)

@@ -306,13 +306,28 @@ class TestFusedInfeed:
         expect = np.stack(values) * 2
         assert bool(np.array_equal(np.asarray(out['tokens']), expect))
 
-    def test_split_routes_host_only_columns_around_jit(self):
+    def test_split_routes_only_planned_columns_by_default(self):
+        """Unplanned columns must stay host numpy: silently returning them
+        as immutable jax.Arrays breaks consumers that mutate in place."""
         plan, _ = plan_for_field(_field('tokens', np.int32, (4,)))
         batch = {'tokens': np.zeros((2, plan.stride), dtype=np.uint8),
                  'idx': np.arange(2),
                  'name': np.array(['a', 'b'], dtype=object)}
         device_cols, host_cols = split_device_columns(batch,
                                                       {'tokens': plan})
+        assert set(device_cols) == {'tokens'}
+        assert set(host_cols) == {'idx', 'name'}
+
+    def test_split_includes_unplanned_numerics_for_fused_transform(self):
+        """A fused device TransformSpec receives the full column dict, so
+        unplanned numeric ndarrays ride the jit with it; object/str columns
+        stay host either way."""
+        plan, _ = plan_for_field(_field('tokens', np.int32, (4,)))
+        batch = {'tokens': np.zeros((2, plan.stride), dtype=np.uint8),
+                 'idx': np.arange(2),
+                 'name': np.array(['a', 'b'], dtype=object)}
+        device_cols, host_cols = split_device_columns(
+            batch, {'tokens': plan}, include_unplanned=True)
         assert set(device_cols) == {'tokens', 'idx'}
         assert set(host_cols) == {'name'}
 
@@ -323,6 +338,26 @@ def token_store(tmp_path_factory):
     url = 'file://' + str(tmp_path_factory.mktemp('device_decode') / 'tok')
     generate_token_dataset(url, rows=64, seq_len=16, vocab=64, seed=3,
                            row_group_size_mb=0.01, ndarray_codec=True)
+    return url
+
+
+@pytest.fixture(scope='module')
+def mixed_store(tmp_path_factory):
+    """Two device-planned ndarray columns plus an UNPLANNED scalar column."""
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+    url = 'file://' + str(tmp_path_factory.mktemp('device_decode_mixed')
+                          / 'mix')
+    schema = Unischema('M', [
+        _field('vec', np.float32, (3,)),
+        _field('aux', np.int16, (2,)),
+        UnischemaField('idx', np.int32, (), ScalarCodec(), False),
+    ])
+    with materialize_dataset(url, schema, row_group_size_mb=0.01) as writer:
+        for i in range(24):
+            writer.write_row({'vec': np.full((3,), i, dtype=np.float32),
+                              'aux': np.array([i, -i], dtype=np.int16),
+                              'idx': np.int32(i)})
     return url
 
 
@@ -411,6 +446,58 @@ class TestEndToEnd:
         assert bool(np.array_equal(np.concatenate(collected), baseline * 2))
         assert snapshot['device_decode_fraction'] == 1.0
 
+    def test_unplanned_columns_stay_numpy(self, mixed_store, monkeypatch):
+        """REVIEW fix: only PLANNED columns come back as jax.Arrays; the
+        unplanned scalar column stays an np.ndarray (zero-copy collated
+        batches are read-only per docs/decode.md, but the TYPE contract —
+        numpy in, numpy out for unplanned columns — must hold with device
+        decode on)."""
+        monkeypatch.setenv(DEVICE_DECODE_ENV_VAR, 'on')
+        seen_types = []
+
+        def bump_idx(batch):
+            seen_types.append(type(batch['idx']))
+            return dict(batch, idx=batch['idx'] + 1)
+
+        collected = []
+        with make_columnar_reader(mixed_store, num_epochs=1, workers_count=1,
+                                  shuffle_row_groups=False) as reader:
+            assert set(reader.device_decode_plans) == {'vec', 'aux'}
+            with JaxDataLoader(reader, batch_size=8,
+                               transform_fn=bump_idx) as loader:
+                for batch in loader:
+                    assert isinstance(batch['idx'], np.ndarray)
+                    collected.append((np.asarray(batch['idx']),
+                                      np.asarray(batch['vec'])))
+        assert seen_types and all(t is np.ndarray for t in seen_types)
+        idx = np.concatenate([i for i, _ in collected])
+        vec = np.concatenate([v for _, v in collected])
+        assert bool(np.array_equal(np.sort(idx), np.arange(1, 25)))
+        assert vec.dtype == np.float32 and vec.shape == (24, 3)
+        assert bool(np.array_equal(vec[:, 0].astype(np.int64), idx - 1))
+
+    def test_host_fallback_counts_rows_per_column(self, mixed_store,
+                                                  monkeypatch):
+        """REVIEW fix: the reader's no-loader host fallback accumulates
+        rows per decoded COLUMN (2 planned columns here), matching the
+        worker batched path's semantics so the derived fractions divide
+        like-for-like."""
+        def epoch(device):
+            monkeypatch.setenv(DEVICE_DECODE_ENV_VAR,
+                               'on' if device else 'off')
+            with make_columnar_reader(mixed_store, num_epochs=1,
+                                      workers_count=1,
+                                      shuffle_row_groups=False) as reader:
+                rows = sum(len(batch.idx) for batch in reader)
+                return rows, reader._stats_snapshot()
+
+        rows, snap_fallback = epoch(True)
+        _, snap_host = epoch(False)
+        assert rows == 24
+        assert snap_fallback['rows_decoded_batched'] == 2 * rows
+        assert (snap_fallback['rows_decoded_batched']
+                == snap_host['rows_decoded_batched'])
+
     def test_row_reader_declines_wholesale(self, token_store, monkeypatch):
         monkeypatch.setenv(DEVICE_DECODE_ENV_VAR, 'on')
         with make_reader(token_store, num_epochs=1,
@@ -455,6 +542,43 @@ class TestShardedLoader:
         assert snapshot['rows_decoded_device'] == len(got)
         assert snapshot['device_decode_fraction'] == 1.0
 
+    def test_transform_fn_declines_claim_and_sees_decoded_numpy(
+            self, token_store, monkeypatch):
+        """REVIEW fix: a host transform_fn runs pre-staging in the inner
+        loader, where post-staging device decode has not happened yet — so
+        the sharded loader must decline the bytes-through claim and let the
+        reader host-decode. The transform must see decoded int32 numpy,
+        never the raw (n, stride) uint8 grid."""
+        from jax.sharding import Mesh
+        from petastorm_tpu.jax_utils import ShardedJaxLoader
+        baseline, _, _, _ = _epoch_tokens(token_store, monkeypatch, False)
+        monkeypatch.setenv(DEVICE_DECODE_ENV_VAR, 'on')
+        mesh = Mesh(np.array(jax.devices()[:1]), ('data',))
+        seen = []
+
+        def double(batch):
+            seen.append((batch['tokens'].dtype, batch['tokens'].shape))
+            return dict(batch, tokens=np.asarray(batch['tokens']) * 2)
+
+        collected = []
+        with make_columnar_reader(token_store, num_epochs=1,
+                                  workers_count=1,
+                                  shuffle_row_groups=False) as reader:
+            assert reader.device_decode_plans   # the reader DID plan
+            with ShardedJaxLoader(reader, mesh, local_batch_size=16,
+                                  transform_fn=double) as loader:
+                for batch in loader:
+                    collected.append(np.asarray(batch['tokens']))
+            snapshot = reader._stats_snapshot()
+        got = np.concatenate(collected)
+        assert seen and all(dt == np.int32 for dt, _ in seen)
+        assert all(shape[1:] == baseline.shape[1:] for _, shape in seen)
+        assert bool(np.array_equal(got, baseline * 2))
+        # nothing decoded on device: the claim was declined, the reader
+        # host-decoded and the host counters carry the whole epoch
+        assert snapshot['rows_decoded_device'] == 0
+        assert snapshot['rows_decoded_batched'] == len(got)
+
 
 class TestEtlRepack:
     @pytest.fixture(scope='class')
@@ -484,6 +608,28 @@ class TestEtlRepack:
         assert isinstance(out.fields['emb'].codec, NdarrayCodec)
         assert isinstance(out.fields['tag'].codec, NdarrayCodec)
 
+    def test_repack_nullable_field_warns_still_ineligible(self, caplog):
+        """REVIEW fix: the codec swap cannot fix static decliners like
+        nullable=True — the repack must say so instead of silently
+        producing a store that still declines device decode."""
+        import logging
+        from petastorm_tpu.etl.repack import (repack_schema,
+                                              still_ineligible_after_repack)
+        schema = Unischema('N', [
+            _field('emb', np.float32, (2,), CompressedNdarrayCodec(),
+                   nullable=True),
+            _field('ok', np.float32, (2,), CompressedNdarrayCodec()),
+        ])
+        with caplog.at_level(logging.WARNING,
+                             logger='petastorm_tpu.etl.repack'):
+            out, repacked = repack_schema(schema)
+        assert sorted(repacked) == ['emb', 'ok']
+        reasons = still_ineligible_after_repack(out, repacked)
+        assert set(reasons) == {'emb'}
+        assert 'nullable' in reasons['emb']
+        assert any('emb' in r.message and 'INELIGIBLE' in r.message
+                   for r in caplog.records)
+
     def test_repack_schema_rejects_bad_field_names(self, compressed_store):
         from petastorm_tpu.etl.dataset_metadata import \
             get_schema_from_dataset_url
@@ -502,6 +648,7 @@ class TestEtlRepack:
         summary = repack_to_ndarray_codec(source_url, out_url)
         assert summary['rows'] == len(rows)
         assert summary['repacked_fields'] == ['emb']
+        assert summary['still_ineligible'] == {}
 
         monkeypatch.setenv(DEVICE_DECODE_ENV_VAR, 'on')
         with make_columnar_reader(source_url, num_epochs=1,
