@@ -304,6 +304,46 @@ def _chaos_entries(artifact, round_no, blob):
     return entries
 
 
+def _objectstore_entries(artifact, round_no, blob):
+    """Entries from the object-store read-plane benchmark (r18): one
+    series per read mode under the recorded trace (serial / prebuffer /
+    ranged are distinct configs of the same store — like-for-like
+    gating), plus the pod-dedup aggregate. The ranged line carries its
+    %-of-raw-ingest-ceiling as roofline context (the artifact's own
+    measured ceiling: planned-range fetch throughput with no parquet
+    assembly)."""
+    entries = []
+    trace = blob.get('trace') or {}
+    config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+              'rows': blob.get('rows'), 'trace': trace.get('name'),
+              'seed': trace.get('seed')}
+    roof = blob.get('roofline') or {}
+    for mode, line in (blob.get('modes') or {}).items():
+        sps = line.get('rows_per_s')
+        if not isinstance(sps, (int, float)):
+            continue
+        roofline_pct = roof.get('roofline_pct') if mode == 'ranged' else None
+        entries.append(_entry(artifact, round_no,
+                              'object_store.{}'.format(mode), config, sps,
+                              roofline_pct=roofline_pct))
+    pod = blob.get('pod') or {}
+    agg = pod.get('aggregate_samples_per_sec')
+    if isinstance(agg, (int, float)):
+        pod_config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+                      'rows': blob.get('rows'),
+                      'k_hosts': pod.get('k_hosts'),
+                      'readers_per_host': pod.get('readers_per_host')}
+        baseline = pod.get('baseline_samples_per_sec')
+        roofline_pct = None
+        if isinstance(baseline, (int, float)) and baseline:
+            # vs the per-host serial baseline: >100% IS the dedup win
+            roofline_pct = round(100.0 * agg / baseline, 2)
+        entries.append(_entry(artifact, round_no,
+                              'object_store.pod_aggregate', pod_config, agg,
+                              roofline_pct=roofline_pct))
+    return entries
+
+
 def _shared_cache_entries(artifact, round_no, blob):
     """Entries from the shared-cache protocol record (r11): the measured
     serial roofline and the aggregate fleet rate."""
@@ -355,6 +395,8 @@ def normalize_artifact(name: str, blob: dict):
         entries.extend(_autotune_entries(name, round_no, payload))
     elif payload.get('benchmark', '') == 'chaos':
         entries.extend(_chaos_entries(name, round_no, payload))
+    elif payload.get('benchmark', '') == 'object_store':
+        entries.extend(_objectstore_entries(name, round_no, payload))
     elif 'baseline_items_per_s' in payload:
         entries.extend(_overhead_entries(name, round_no, payload))
     elif 'shared' in payload and 'roofline' in payload:

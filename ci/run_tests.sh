@@ -89,6 +89,12 @@ PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_workers_pool.py -q
 echo '== shared-cache quick bench (K readers x one dataset, decoded once) =='
 python -m petastorm_tpu.benchmark.shared_cache --quick
 
+echo '== object-store quick checks (range planning, ranged reads, trace replay, peer cache; lockdep on) =='
+PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_objectstore.py -q
+
+echo '== object-store quick bench (serial/prebuffer/ranged under the recorded trace + pod dedup) =='
+python -m petastorm_tpu.benchmark.object_store --quick
+
 echo '== profiler quick checks (attribution, calibration cache, advisor, /profile) =='
 python -m pytest tests/test_profiler.py -q
 

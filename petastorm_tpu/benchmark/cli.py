@@ -9,6 +9,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 
 from petastorm_tpu.benchmark.throughput import reader_throughput
@@ -124,6 +125,19 @@ def build_parser() -> argparse.ArgumentParser:
                              "transform errors, 'skip' drops failing rows "
                              "counting them, 'quarantine' drops AND records "
                              'provenance-tagged quarantine records')
+    parser.add_argument('--remote-read', default=None,
+                        choices=['serial', 'prebuffer', 'ranged', 'auto'],
+                        help='row-group fetch strategy against the object '
+                             "store: 'serial' opens and reads sequentially, "
+                             "'prebuffer' uses the Arrow pre-buffered reads, "
+                             "'ranged' plans coalesced parallel range reads "
+                             'from the Parquet footer (see '
+                             "docs/object_store.md); 'auto'/omitted picks "
+                             'per-protocol')
+    parser.add_argument('--storage-options', metavar='JSON', default=None,
+                        help='JSON object of fsspec storage options handed '
+                             'to the filesystem resolver, e.g. '
+                             '\'{"anon": true}\' for public s3:// buckets')
     parser.add_argument('-v', action='store_true', help='INFO logging')
     return parser
 
@@ -140,6 +154,12 @@ def main(argv=None) -> int:
                                           and args.cache_size_limit):
         raise SystemExit('--cache-type {} needs --cache-location and '
                          '--cache-size-limit'.format(args.cache_type))
+    storage_options = None
+    if args.storage_options:
+        storage_options = json.loads(args.storage_options)
+        if not isinstance(storage_options, dict):
+            raise SystemExit('--storage-options must be a JSON object, got '
+                             '{!r}'.format(args.storage_options))
     slo = {}
     if args.slo_p99_ms is not None:
         slo['p99_e2e_ms'] = args.slo_p99_ms
@@ -160,7 +180,8 @@ def main(argv=None) -> int:
         profile=args.profile, slo=slo or None, autotune=args.autotune,
         on_decode_error=args.on_decode_error, cache_type=args.cache_type,
         cache_location=args.cache_location,
-        cache_size_limit=args.cache_size_limit)
+        cache_size_limit=args.cache_size_limit,
+        remote_read=args.remote_read, storage_options=storage_options)
         for _ in range(max(1, args.runs))]
     # headline = median run: the honest central figure (best would overstate)
     by_rate = sorted(results, key=lambda r: r.samples_per_sec)
@@ -176,7 +197,6 @@ def main(argv=None) -> int:
                   len(rates), rates[0], median, rates[-1],
                   100.0 * (rates[-1] - rates[0]) / median if median else 0.0))
     if args.diagnostics and result.diagnostics is not None:
-        import json
         print('Pipeline telemetry (median run): {}'.format(
             json.dumps({k: (round(v, 6) if isinstance(v, float) else v)
                         for k, v in sorted(result.diagnostics.items())
@@ -189,24 +209,19 @@ def main(argv=None) -> int:
             print('Infeed diagnosis (median run): {}'.format(
                 json.dumps(result.diagnosis, sort_keys=True)))
     if args.profile and result.profile is not None:
-        import json
-
         from petastorm_tpu.profiler import explain
         print('Roofline (median run): {}'.format(explain(result.profile)))
         print('Roofline profile: {}'.format(
             json.dumps(result.profile, sort_keys=True, default=str)))
     if slo and result.slo is not None:
-        import json
         print('SLO verdict (median run): {}'.format(
             json.dumps(result.slo, sort_keys=True, default=str)))
     if args.autotune and result.autotune is not None:
-        import json
         report = dict(result.autotune)
         report['actions'] = report.get('actions', [])[-10:]
         print('Autotune report (median run): {}'.format(
             json.dumps(report, sort_keys=True, default=str)))
     if args.audit and result.audit is not None:
-        import json
         print('Coverage audit (median run): {}'.format(
             json.dumps(result.audit, sort_keys=True, default=str)))
     if args.trace:

@@ -95,7 +95,9 @@ def reader_throughput(dataset_url: str,
                       on_decode_error: str = 'raise',
                       cache_type: str = 'null',
                       cache_location: Optional[str] = None,
-                      cache_size_limit: Optional[int] = None) -> ThroughputResult:
+                      cache_size_limit: Optional[int] = None,
+                      remote_read: Optional[str] = None,
+                      storage_options: Optional[dict] = None) -> ThroughputResult:
     """Measure reader throughput on ``dataset_url``.
 
     ``read_method='python'`` iterates raw reader rows/batches;
@@ -121,7 +123,8 @@ def reader_throughput(dataset_url: str,
                   on_decode_error=on_decode_error, cache_type=cache_type,
                   cache_location=cache_location,
                   cache_size_limit=cache_size_limit, slo=slo,
-                  autotune=autotune)
+                  autotune=autotune, remote_read=remote_read,
+                  storage_options=storage_options)
     if field_regex is not None:
         kwargs['schema_fields'] = field_regex
 

@@ -33,6 +33,13 @@ scenario                  injected faults
 ``cache-enospc``          shared-cache segment publication raises
                           ``OSError(ENOSPC)`` at ``enospc_rate`` (the cache
                           degrades to direct decode; see ``docs/cache.md``)
+``trace-replay``          every read pays a first-byte latency + size/bandwidth
+                          delay drawn from a *recorded* object-store trace
+                          (``trace=<file-or-builtin-name>``; see
+                          ``benchmark/traces/`` and ``docs/object_store.md``) —
+                          deterministic per (seed, path, range, occurrence), so
+                          hedge thresholds and range planning are tuned against
+                          a realistic S3-shaped tail without cloud credentials
 ========================  ====================================================
 
 Harness hook: set ``PETASTORM_TPU_CHAOS='<scenario>:<seed>'`` (e.g.
@@ -75,7 +82,43 @@ SCENARIOS: Dict[str, dict] = {
     # latency (plus an optional per-byte bandwidth cost), faultlessly —
     # what benchmark/readahead.py's SlowFilesystem now resolves to
     'fixed-latency': dict(seconds_per_read=0.0, seconds_per_mb=0.0),
+    # replay a recorded object-store latency/bandwidth distribution:
+    # trace = path to a trace JSON or a builtin name under
+    # benchmark/traces/ (e.g. 's3-us-east-1'); scales stretch/shrink the
+    # recorded samples without re-recording
+    'trace-replay': dict(trace='', latency_scale=1.0, bandwidth_scale=1.0),
 }
+
+
+def trace_path(name: str) -> str:
+    """Resolve a trace spec to a file path: an existing path is itself; a
+    bare name resolves to the committed ``benchmark/traces/<name>.json``."""
+    if os.path.exists(name):
+        return name
+    builtin = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           'benchmark', 'traces', name + '.json')
+    if os.path.exists(builtin):
+        return builtin
+    raise ValueError('unknown trace {!r}: not a file, and no builtin trace '
+                     '{}'.format(name, builtin))
+
+
+def load_trace(name: str) -> dict:
+    """Load + validate a recorded object-store trace (see
+    ``docs/object_store.md`` for the format). Fails fast on a missing or
+    malformed trace — a chaos run silently replaying nothing would be the
+    worst failure mode."""
+    import json
+    with open(trace_path(name), 'r') as f:
+        trace = json.load(f)
+    for field in ('first_byte_latency_s', 'bandwidth_bytes_per_s'):
+        samples = trace.get(field)
+        if not isinstance(samples, list) or not samples \
+                or not all(isinstance(s, (int, float)) and s > 0
+                           for s in samples):
+            raise ValueError('trace {!r}: {} must be a non-empty list of '
+                             'positive numbers'.format(name, field))
+    return trace
 
 
 class SimulatedWorkerCrash(SystemExit):
@@ -115,6 +158,15 @@ class FaultInjector:
         self._reads = 0
         #: Injection tally by fault kind (diagnostics + test assertions).
         self.injected: Dict[str, int] = {}
+        #: Injected *time* tally by kind, seconds (e.g. the total replayed
+        #: trace latency) — the float companion of :attr:`injected`.
+        self.injected_s: Dict[str, float] = {}
+        self._trace: Optional[dict] = None
+        if scenario == 'trace-replay':
+            if not params['trace']:
+                raise ValueError("trace-replay needs trace=<file-or-name>, "
+                                 "e.g. 'trace-replay:0:trace=s3-us-east-1'")
+            self._trace = load_trace(str(params['trace']))
 
     # -- decisions -------------------------------------------------------------
 
@@ -135,6 +187,10 @@ class FaultInjector:
     def _count(self, kind: str) -> None:
         with self._lock:
             self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def _count_s(self, kind: str, seconds: float) -> None:
+        with self._lock:
+            self.injected_s[kind] = self.injected_s.get(kind, 0.0) + seconds
 
     def _in_cooldown(self, path: str) -> bool:
         """True (and consume one cooldown tick) while ``path`` is inside
@@ -223,6 +279,38 @@ class FaultInjector:
             return data[:max(1, len(data) // 2)]
         return data
 
+    def trace_delay(self, path: str, offset: int, nbytes: int) -> None:
+        """Replay one recorded object-store read against ``(path, offset,
+        nbytes)``: sleep a first-byte latency sample plus ``nbytes`` over a
+        bandwidth sample, both drawn deterministically from the trace.
+
+        The draw is keyed on the *range* (path + offset + nbytes) plus a
+        per-range occurrence counter: two different in-flight ranges replay
+        independent samples regardless of thread completion order (the
+        parallel range reader stays deterministic), while a hedge or retry
+        of the SAME range re-draws — exactly the behavior that makes
+        hedging win against a recorded tail."""
+        if self._trace is None:
+            return
+        p = self.params
+        key = '{}@{}+{}'.format(os.path.basename(path), offset, nbytes)
+        occurrence = self._occurrence(key, 'trace')
+        fb_samples = self._trace['first_byte_latency_s']
+        bw_samples = self._trace['bandwidth_bytes_per_s']
+        fb_draw = self._uniform(key, 'trace-fb', occurrence)
+        bw_draw = self._uniform(key, 'trace-bw', occurrence)
+        fb = fb_samples[min(int(fb_draw * len(fb_samples)),
+                            len(fb_samples) - 1)]
+        bw = bw_samples[min(int(bw_draw * len(bw_samples)),
+                            len(bw_samples) - 1)]
+        delay = fb * p['latency_scale']
+        if nbytes:
+            delay += nbytes / (bw * p['bandwidth_scale'])
+        self._count('trace_reads')
+        self._count_s('trace_latency_s', delay)
+        if delay > 0:
+            time.sleep(delay)
+
     # -- cache-side hook -------------------------------------------------------
 
     def cache_put_fault(self, key: str) -> None:
@@ -251,10 +339,19 @@ class FaultyFile:
         self._path = path
 
     def read(self, *args, **kwargs):
-        self._owner.injector.before_read(self._path)
+        injector = self._owner.injector
+        # the replayed trace keys on the byte range, so capture the offset
+        # BEFORE the inner read advances it (only when a trace is armed —
+        # tell() on every read would tax the faultless scenarios)
+        offset = (self._inner.tell()
+                  if injector.scenario == 'trace-replay' else 0)
+        injector.before_read(self._path)
         data = self._inner.read(*args, **kwargs)
-        self._owner.on_read(len(data) if data is not None else 0)
-        return self._owner.injector.after_read(self._path, data)
+        nbytes = len(data) if data is not None else 0
+        self._owner.on_read(nbytes)
+        data = injector.after_read(self._path, data)
+        injector.trace_delay(self._path, offset, nbytes)
+        return data
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
@@ -297,7 +394,7 @@ class FaultyFilesystem:
 #: cache-publication fault, which arms inside the shared cache instead).
 _FS_SCENARIOS = frozenset({'transient-errors', 'tail-latency', 'read-hangs',
                            'truncated-reads', 'worker-kill',
-                           'fixed-latency'})
+                           'fixed-latency', 'trace-replay'})
 
 _env_cache_lock = threading.Lock()
 _env_cache: Dict[str, Optional[FaultInjector]] = {}
@@ -322,7 +419,11 @@ def parse_chaos(value: str) -> Optional[FaultInjector]:
             try:
                 overrides[key.strip()] = int(raw)
             except ValueError:
-                overrides[key.strip()] = float(raw)
+                try:
+                    overrides[key.strip()] = float(raw)
+                except ValueError:
+                    # string-valued params (trace-replay's trace=<name>)
+                    overrides[key.strip()] = raw.strip()
     return FaultInjector(scenario, seed=seed, **overrides)
 
 

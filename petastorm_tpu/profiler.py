@@ -73,8 +73,11 @@ CALIBRATION_DIR_ENV_VAR = 'PETASTORM_TPU_CALIBRATION_DIR'
 #: path (``host-batched`` / ``per-cell`` / ``device``) produced each
 #: ceiling, and ``device_decode`` / ``ingest`` ceilings joined the
 #: artifact — pre-upgrade artifacts carry neither and must not judge
-#: device measurements, so they read as a cache miss.
-PROBE_SCHEMA_VERSION = 3
+#: device measurements, so they read as a cache miss. Bumped to 4 when the
+#: storage probe gained the coalesced-ranged read mode (``objectstore``):
+#: the io ceiling is now max over three open modes, so a version-3 io
+#: ceiling would under-report the store a ranged reader actually has.
+PROBE_SCHEMA_VERSION = 4
 
 #: Pipeline stages a ceiling is calibrated for, in pipeline order.
 #: ``device_decode`` (jitted bytes-through decode) and ``ingest`` (raw
@@ -215,9 +218,11 @@ PROBE_REPS = 5
 
 def _probe_storage(filesystem, sampled) -> dict:
     """Sequential-read bandwidth of the dataset's own files, plus the parquet
-    row-group read rate under the two open modes the workers choose between
+    row-group read rate under the three open modes the workers choose between
     (plain for local filesystems, ``pre_buffer=True`` for remote — see
-    ``piece_worker._LOCAL_PROTOCOLS``). Page-cache state is whatever the
+    ``piece_worker._LOCAL_PROTOCOLS`` — and the coalesced parallel-range
+    plan of ``objectstore.ParallelRangeReader``, the ranged-ingest
+    ceiling). Page-cache state is whatever the
     host has (recorded as ``page_cache: 'ambient'``): these are sustained
     re-read ceilings, the regime epochs 2+ run in."""
     import pyarrow.parquet as pq
@@ -257,9 +262,21 @@ def _probe_storage(filesystem, sampled) -> dict:
                 handle.close()
         return read_s, rows
 
+    def timed_ranged_read() -> Tuple[float, int]:
+        from petastorm_tpu.objectstore import ParallelRangeReader
+        reader = ParallelRangeReader(filesystem)
+        read_s, rows = 0.0, 0
+        for piece in sampled:
+            start = time.perf_counter()
+            table = reader.read_row_group(piece.path, piece.row_group)
+            read_s += time.perf_counter() - start
+            rows += table.num_rows
+        return read_s, rows
+
     plain_s, rows = min(timed_read(pre_buffer=False)
                         for _ in range(PROBE_REPS))
     pre_s, _ = min(timed_read(pre_buffer=True) for _ in range(PROBE_REPS))
+    ranged_s, _ = min(timed_ranged_read() for _ in range(PROBE_REPS))
     return {
         'page_cache': 'ambient',
         'bytes': total_bytes,
@@ -268,6 +285,8 @@ def _probe_storage(filesystem, sampled) -> dict:
         'parquet_rows_per_s': round(rows / plain_s, 1) if plain_s else None,
         'parquet_pre_buffer_rows_per_s': round(rows / pre_s, 1)
         if pre_s else None,
+        'parquet_ranged_rows_per_s': round(rows / ranged_s, 1)
+        if ranged_s else None,
         'parquet_read_s': round(plain_s, 4),
         'rows': rows,
     }
@@ -572,11 +591,12 @@ def calibrate(filesystem, dataset_path, pieces, schema,
         device_decode = _probe_device_decode(plans, raw_columns, raw_rows)
         ingest = _probe_ingest(raw_columns, raw_rows)
     total_rows = sum(max(0, p.num_rows) for p in pieces)
-    # the faster of the two open modes is the storage ceiling: the workers
-    # pick per filesystem, and the roofline should not punish a dataset for
-    # the mode it does not use
+    # the fastest of the open modes is the storage ceiling: the workers
+    # pick per filesystem (and ``remote_read='ranged'`` by request), and
+    # the roofline should not punish a dataset for the mode it does not use
     io_rates = [r for r in (storage.get('parquet_rows_per_s'),
-                            storage.get('parquet_pre_buffer_rows_per_s'))
+                            storage.get('parquet_pre_buffer_rows_per_s'),
+                            storage.get('parquet_ranged_rows_per_s'))
                 if r]
     ceilings = {
         'io': max(io_rates) if io_rates else None,

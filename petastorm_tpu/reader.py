@@ -187,7 +187,7 @@ def make_reader(dataset_url,
                 metrics_out=None, debug_port=None, stall_timeout=0,
                 flight_record_dir=None, on_decode_error='raise',
                 slo=None, autotune=False, retry=None, hedge=None,
-                worker_recovery=None):
+                remote_read=None, worker_recovery=None):
     """Row-granular reader for petastorm_tpu datasets (codec-decoded rows).
 
     Mirrors the reference factory (``reader.py:61-195``). Raises a helpful error
@@ -256,6 +256,12 @@ def make_reader(dataset_url,
     worker and re-ventilates its in-flight items exactly once, with bounded
     respawns and poison-item quarantine. ``PETASTORM_TPU_CHAOS`` arms the
     deterministic fault-injection harness.
+
+    ``remote_read=`` picks the storage read plane
+    (``docs/object_store.md``): ``'serial'`` (plain reads), ``'prebuffer'``
+    (pyarrow-coalesced column chunks), ``'ranged'`` (explicit footer-planned
+    parallel range fetches; retry/hedge then apply per RANGE, not per row
+    group). Default auto: ``prebuffer`` for object stores, ``serial`` local.
     """
     dataset_url = normalize_dataset_url_or_urls(dataset_url)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url, storage_options)
@@ -294,7 +300,8 @@ def make_reader(dataset_url,
                   debug_port=debug_port, stall_timeout=stall_timeout,
                   flight_record_dir=flight_record_dir,
                   on_decode_error=on_decode_error, slo=slo,
-                  autotune=autotune, retry=retry, hedge=hedge)
+                  autotune=autotune, retry=retry, hedge=hedge,
+                  remote_read=remote_read)
 
 
 def make_columnar_reader(dataset_url,
@@ -315,7 +322,7 @@ def make_columnar_reader(dataset_url,
                          metrics_out=None, debug_port=None, stall_timeout=0,
                          flight_record_dir=None, on_decode_error='raise',
                          slo=None, autotune=False, retry=None, hedge=None,
-                         worker_recovery=None):
+                         remote_read=None, worker_recovery=None):
     """Vectorized codec-decoded reader for petastorm_tpu datasets.
 
     Yields **batch namedtuples of decoded numpy column arrays** (one per row
@@ -369,7 +376,8 @@ def make_columnar_reader(dataset_url,
                   debug_port=debug_port, stall_timeout=stall_timeout,
                   flight_record_dir=flight_record_dir,
                   on_decode_error=on_decode_error, slo=slo,
-                  autotune=autotune, retry=retry, hedge=hedge)
+                  autotune=autotune, retry=retry, hedge=hedge,
+                  remote_read=remote_read)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -387,7 +395,8 @@ def make_batch_reader(dataset_url_or_urls,
                       metrics_interval=0, metrics_out=None, debug_port=None,
                       stall_timeout=0, flight_record_dir=None,
                       on_decode_error='raise', slo=None, autotune=False,
-                      retry=None, hedge=None, worker_recovery=None):
+                      retry=None, hedge=None, remote_read=None,
+                      worker_recovery=None):
     """Vectorized batch reader for arbitrary parquet stores
     (reference ``reader.py:198-327``). Yields namedtuples of column arrays,
     one per row group. ``io_readahead`` prefetches upcoming row-group reads
@@ -425,7 +434,8 @@ def make_batch_reader(dataset_url_or_urls,
                   stall_timeout=stall_timeout,
                   flight_record_dir=flight_record_dir,
                   on_decode_error=on_decode_error, slo=slo,
-                  autotune=autotune, retry=retry, hedge=hedge)
+                  autotune=autotune, retry=retry, hedge=hedge,
+                  remote_read=remote_read)
 
 
 class Reader:
@@ -441,7 +451,8 @@ class Reader:
                  io_readahead=0, trace_export=None, metrics_interval=0,
                  metrics_out=None, debug_port=None, stall_timeout=0,
                  flight_record_dir=None, on_decode_error='raise',
-                 slo=None, autotune=False, retry=None, hedge=None):
+                 slo=None, autotune=False, retry=None, hedge=None,
+                 remote_read=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -463,6 +474,11 @@ class Reader:
         from petastorm_tpu.resilience import resolve_hedge, resolve_retry
         retry_options = resolve_retry(retry)
         hedge_options = resolve_hedge(hedge)
+        # remote read plane (docs/object_store.md): validate here so a
+        # typo'd mode fails the factory; None = per-protocol auto in the
+        # worker ('prebuffer' remote / 'serial' local, the pre-knob shape)
+        from petastorm_tpu.objectstore import resolve_remote_read
+        remote_read = resolve_remote_read(remote_read)
         if slo:
             # fail fast on a typo'd target name; the monitor itself is
             # built after the pool (it reads the stats snapshot + latency)
@@ -719,6 +735,7 @@ class Reader:
             # means "default" to the worker, which is not the same thing)
             'retry': retry_options if retry_options else False,
             'hedge': hedge_options if hedge_options else False,
+            'remote_read': remote_read,
             'on_decode_error': on_decode_error,
             'shard': cur_shard if cur_shard is not None else -1,
             'filesystem_factory': filesystem_factory,

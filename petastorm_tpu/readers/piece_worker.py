@@ -8,7 +8,7 @@ import hashlib
 import threading
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 import pyarrow.parquet as pq
@@ -63,32 +63,43 @@ class FileHandleCache:
     thread and the readahead thread hold disjoint instances, because a
     ``ParquetFile`` must not serve two concurrent reads); the lock only
     guards the bookkeeping so occupancy can be inspected cross-thread.
+
+    Entries key on ``(filesystem identity, path)``, not path alone:
+    ``fs_key`` (a callable returning the identity of the filesystem
+    ``open_fn`` currently resolves to) partitions the cache so a
+    chaos/trace-wrapped filesystem and the clean one can never share a
+    cached handle — a handle opened through a fault wrapper replays faults,
+    one opened clean does not, and serving either for the other silently
+    changes what a run measures.
     """
 
-    def __init__(self, open_fn, max_size: int = FILE_HANDLE_CACHE_SIZE):
+    def __init__(self, open_fn, max_size: int = FILE_HANDLE_CACHE_SIZE,
+                 fs_key: Optional[Callable[[], object]] = None):
         if max_size < 1:
             raise ValueError('max_size must be >= 1, got {}'.format(max_size))
         self._open_fn = open_fn
+        self._fs_key = fs_key if fs_key is not None else lambda: None
         self._max_size = max_size
-        self._entries: 'OrderedDict[str, pq.ParquetFile]' = OrderedDict()
+        self._entries: 'OrderedDict[tuple, pq.ParquetFile]' = OrderedDict()
         self._lock = threading.Lock()
 
     def get(self, path: str) -> pq.ParquetFile:
+        key = (self._fs_key(), path)
         with self._lock:
-            handle = self._entries.get(path)
+            handle = self._entries.get(key)
             if handle is not None:
-                self._entries.move_to_end(path)
+                self._entries.move_to_end(key)
                 return handle
         handle = self._open_fn(path)
         evicted = []
         with self._lock:
-            raced = self._entries.get(path)
+            raced = self._entries.get(key)
             if raced is not None:
-                self._entries.move_to_end(path)
+                self._entries.move_to_end(key)
                 evicted.append(handle)   # lost a race; keep the cached one
                 handle = raced
             else:
-                self._entries[path] = handle
+                self._entries[key] = handle
                 while len(self._entries) > self._max_size:
                     evicted.append(self._entries.popitem(last=False)[1])
         for old in evicted:
@@ -96,12 +107,14 @@ class FileHandleCache:
         return handle
 
     def invalidate(self, path: str) -> None:
-        """Close and drop the cached handle for ``path`` (retry hygiene: a
-        handle that just failed mid-read may be stuck mid-stream — the next
-        attempt must reopen, not resume a poisoned position)."""
+        """Close and drop every cached handle for ``path`` — across ALL
+        filesystem identities (retry hygiene: a handle that just failed
+        mid-read may be stuck mid-stream — the next attempt must reopen,
+        not resume a poisoned position)."""
         with self._lock:
-            handle = self._entries.pop(path, None)
-        if handle is not None:
+            stale = [k for k in self._entries if k[1] == path]
+            handles = [self._entries.pop(k) for k in stale]
+        for handle in handles:
             handle.close()
 
     def __len__(self) -> int:
@@ -110,7 +123,7 @@ class FileHandleCache:
 
     def __contains__(self, path: str) -> bool:
         with self._lock:
-            return path in self._entries
+            return any(k[1] == path for k in self._entries)
 
     def close_all(self) -> None:
         with self._lock:
@@ -158,14 +171,30 @@ class ParquetPieceWorker(WorkerBase):
         # columns skip host decode and ship as raw (n, stride) uint8 grids.
         # The reader plans once; workers only execute the shipped plan.
         self._device_plans = args.get('device_decode_plans') or {}
-        # pre_buffer coalesces a row group's column chunks into few large
-        # ranged reads — the right shape for object stores (GCS/S3/HDFS),
-        # pure overhead for local mmap-fast files
+        # -- remote read plane (docs/object_store.md) --------------------------
+        # 'serial': plain sequential reads; 'prebuffer': pyarrow coalesces
+        # column chunks internally; 'ranged': explicit footer-planned
+        # parallel range fetches with per-RANGE retry/hedge. Auto picks
+        # prebuffer for object stores (GCS/S3/HDFS) and serial for local
+        # mmap-fast files — the pre-knob behavior.
+        from petastorm_tpu.objectstore import (ParallelRangeReader,
+                                               resolve_remote_read)
         protocol = getattr(self._filesystem, 'protocol', '')
         if isinstance(protocol, (tuple, list)):
             protocol = protocol[0] if protocol else ''
-        self._pre_buffer = protocol not in _LOCAL_PROTOCOLS
-        self._open_files = FileHandleCache(self._open_parquet)
+        mode = resolve_remote_read(args.get('remote_read'))
+        if mode is None:
+            mode = ('serial' if protocol in _LOCAL_PROTOCOLS
+                    else 'prebuffer')
+        self._remote_read = mode
+        self._pre_buffer = mode == 'prebuffer'
+        # one range reader per worker, shared with the readahead thread
+        # (thread-safe: every read builds its own buffer and store handles)
+        self._range_reader = (ParallelRangeReader(
+            self._filesystem, resilience=self._resilience)
+            if mode == 'ranged' else None)
+        self._open_files = FileHandleCache(
+            self._open_parquet, fs_key=lambda: id(self._filesystem))
         # cache-key components are per-worker constants: hash them once, not
         # per ventilated piece
         self._dataset_path_digest = hashlib.md5(
@@ -217,7 +246,8 @@ class ParquetPieceWorker(WorkerBase):
             from petastorm_tpu.readers.readahead import RowGroupReadahead
             # the background thread gets its own handle cache: a ParquetFile
             # must never serve two concurrent reads
-            self._prefetch_files = FileHandleCache(self._open_parquet)
+            self._prefetch_files = FileHandleCache(
+                self._open_parquet, fs_key=lambda: id(self._filesystem))
             # the background reader thread publishes its own heartbeat
             # entity next to the worker's (a wedged prefetch read must be
             # attributable to the readahead thread, not the worker)
@@ -339,7 +369,14 @@ class ParquetPieceWorker(WorkerBase):
         state with the worker thread. Retried under the shared policy (a
         transient storage error must not surface as a failed prefetch the
         worker re-raises); hedging stays on the synchronous path only — the
-        background read is already asynchronous to the worker."""
+        background read is already asynchronous to the worker. In ranged
+        mode the shared range reader carries its own per-range retry/hedge,
+        so it is used directly (it never shares handles between threads —
+        every read opens its own)."""
+        if self._range_reader is not None:
+            return self._range_reader.read_row_group(
+                piece.path, piece.row_group, columns=columns)
+
         def read():
             return self._prefetch_files.get(piece.path).read_row_group(
                 piece.row_group, columns=columns)
@@ -390,7 +427,15 @@ class ParquetPieceWorker(WorkerBase):
         cache. The open-per-read cost is the documented price of hedging
         (it targets remote tail-latency stores, where open is cheap next to
         the tail). Retry-only readers keep the cached handle and invalidate
-        it before each retry."""
+        it before each retry.
+
+        In ``remote_read='ranged'`` mode the whole-row-group layers are
+        bypassed: the range reader applies retry AND hedge **per range**
+        inside ``fetch_range`` — a straggling range is hedged alone, which
+        is the entire point of planning the read as explicit ranges."""
+        if self._range_reader is not None:
+            return self._range_reader.read_row_group(
+                piece.path, piece.row_group, columns=columns)
         resilience = self._resilience
         if resilience is None or not resilience.enabled:
             return self._parquet_file(piece.path).read_row_group(
@@ -421,6 +466,10 @@ class ParquetPieceWorker(WorkerBase):
         thread only — the hedge helper threads and the readahead thread
         accumulate into the resilience object's own lock-protected dict,
         exactly like the readahead stats drain)."""
+        if self._range_reader is not None:
+            for name, n in self._range_reader.take_events().items():
+                if n:
+                    self.record_count(name, n)
         if self._resilience is None:
             return
         for name, n in self._resilience.take_events().items():

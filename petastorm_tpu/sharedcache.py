@@ -24,6 +24,16 @@ process-pool workers) attaches to:
   filesystems use ``pre_buffer`` coalesced range reads
   (``ParquetPieceWorker._plan_item`` consults :meth:`SharedRowGroupCache.contains`
   so only *missing* keys are prefetched).
+- **Pod tier — peer caches** (``docs/object_store.md``). With ``peers=``
+  configured, a host-local miss checks the other hosts' caches over a
+  minimal HTTP segment protocol (:class:`PeerCacheServer`, served via
+  :meth:`SharedRowGroupCache.serve_peers`) before touching the object
+  store; a fetched segment is re-validated and republished locally.
+  ``peer_hedge_s`` races the peer fetch against the local decode under the
+  shared :class:`~petastorm_tpu.resilience.HedgedRead` plane. Peer-sourced
+  payloads count ``peer_hits`` — never ``fills`` — so summing ``fills``
+  across every root's :meth:`SharedRowGroupCache.global_counters` proves
+  each row group was decoded once per **pod**.
 
 Concurrency and crash-safety contracts:
 
@@ -526,6 +536,20 @@ class SharedRowGroupCache(CacheBase):
         this long for another process's in-flight fill before decoding
         locally (correctness over decode-once).
     :param cleanup: remove this cache's directories on :meth:`cleanup`.
+    :param peers: pod tier (``docs/object_store.md``): ``['host:port', ...]``
+        peer-cache endpoints (each the :meth:`serve_peers` port of another
+        host's cache root). A local miss then checks the pod before
+        decoding: a validated peer segment is republished locally and
+        counts ``peer_hits`` — never ``fills`` — so summing ``fills`` over
+        every root's :meth:`global_counters` certifies each row group was
+        decoded once per POD, not once per host.
+    :param peer_timeout_s: per-peer HTTP timeout for pod-tier fetches.
+    :param peer_hedge_s: when set, a pod-tier fetch is *hedged* against the
+        local fill: the peer fetch runs as the primary and the local
+        decode fires as the hedge after this many seconds — a slow/wedged
+        peer costs bounded latency, while a fast peer still saves the
+        decode (a single once-gate keeps the fill exactly-once either
+        way). ``None`` = sequential peers-then-fill.
 
     Instances are picklable (process-pool ``worker_args``): the unpickled
     copy re-attaches to the same tiers with fresh local state.
@@ -536,7 +560,10 @@ class SharedRowGroupCache(CacheBase):
                  mem_dir: Optional[str] = None,
                  attach_limit: int = _DEFAULT_ATTACH_LIMIT,
                  lock_timeout_s: float = 30.0,
-                 cleanup: bool = False):
+                 cleanup: bool = False,
+                 peers: Optional[List[str]] = None,
+                 peer_timeout_s: float = 2.0,
+                 peer_hedge_s: Optional[float] = None):
         if not path:
             raise ValueError("cache_type='shared' needs a cache_location "
                              'directory shared by every attaching reader')
@@ -552,6 +579,9 @@ class SharedRowGroupCache(CacheBase):
         self._attach_limit = max(1, attach_limit)
         self._lock_timeout_s = lock_timeout_s
         self._cleanup_on_exit = cleanup
+        self._peers = list(peers or [])
+        self._peer_timeout_s = peer_timeout_s
+        self._peer_hedge_s = peer_hedge_s
         self._init_runtime()
 
     def _init_runtime(self) -> None:
@@ -571,10 +601,24 @@ class SharedRowGroupCache(CacheBase):
         os.makedirs(self._counters_dir, exist_ok=True)
         self._attached: 'OrderedDict[str, _Attachment]' = OrderedDict()
         self._events = {'shared_hits': 0, 'shared_misses': 0,
-                        'shared_evictions': 0, 'shared_put_failures': 0}
+                        'shared_evictions': 0, 'shared_put_failures': 0,
+                        'shared_peer_hits': 0, 'shared_peer_misses': 0,
+                        'shared_peer_errors': 0}
         self._totals = {'hits': 0, 'misses': 0, 'fills': 0, 'evictions': 0,
                         'spills': 0, 'corrupt_dropped': 0, 'lock_waits': 0,
-                        'lock_steals': 0, 'put_failures': 0}
+                        'lock_steals': 0, 'put_failures': 0,
+                        'peer_hits': 0, 'peer_misses': 0, 'peer_errors': 0,
+                        'peer_bytes': 0}
+        #: the pod-tier hedge plane (docs/object_store.md): a fixed-threshold
+        #: HedgedRead racing "fetch from a peer's cache" against "decode
+        #: locally" — the same primitive the range reader uses per range
+        self._peer_hedge = None
+        if self._peers and self._peer_hedge_s is not None:
+            from petastorm_tpu.resilience import HedgedRead
+            self._peer_hedge = HedgedRead(
+                dict(threshold_s=float(self._peer_hedge_s)),
+                on_event=self._peer_hedge_event)
+        self._peer_server: Optional['PeerCacheServer'] = None
         self._events_since_flush = 0
         self._counter_path = os.path.join(
             self._counters_dir,
@@ -625,7 +669,10 @@ class SharedRowGroupCache(CacheBase):
                 'mem_dir': self._mem_dir_override,
                 'attach_limit': self._attach_limit,
                 'lock_timeout_s': self._lock_timeout_s,
-                'cleanup': self._cleanup_on_exit}
+                'cleanup': self._cleanup_on_exit,
+                'peers': self._peers,
+                'peer_timeout_s': self._peer_timeout_s,
+                'peer_hedge_s': self._peer_hedge_s}
 
     def __setstate__(self, state):
         self._path = state['path']
@@ -635,6 +682,9 @@ class SharedRowGroupCache(CacheBase):
         self._attach_limit = state['attach_limit']
         self._lock_timeout_s = state['lock_timeout_s']
         self._cleanup_on_exit = state['cleanup']
+        self._peers = state.get('peers', [])
+        self._peer_timeout_s = state.get('peer_timeout_s', 2.0)
+        self._peer_hedge_s = state.get('peer_hedge_s')
         self._init_runtime()
 
     # -- lookup ----------------------------------------------------------------
@@ -869,6 +919,104 @@ class SharedRowGroupCache(CacheBase):
                 return None
         return None
 
+    # -- pod tier (peer caches; docs/object_store.md) --------------------------
+
+    def _bump(self, total_key: str, event_key: str, n: int = 1) -> None:
+        with self._lock:
+            self._totals[total_key] = self._totals.get(total_key, 0) + n
+            self._events[event_key] = self._events.get(event_key, 0) + n
+
+    def _peer_hedge_event(self, name: str, n: int = 1) -> None:
+        # io_hedges / io_hedge_wins / io_hedge_losses from the pod-tier
+        # HedgedRead, renamed into the cache's own counter families
+        short = name.replace('io_', 'peer_')
+        self._bump(short, 'shared_' + short, n)
+
+    def segment_bytes(self, digest: str) -> Optional[bytes]:
+        """Raw bytes of a resident segment, tier 0 first (the peer-protocol
+        server side; ``None`` = miss). Lock-free like every read: publishers
+        ``os.replace`` whole files, so the bytes read are a complete segment
+        (the fetching peer re-validates header+trailer before publishing)."""
+        for store in (self._mem, self._disk):
+            try:
+                with open(store.path_for(digest), 'rb') as f:
+                    return f.read()
+            except OSError:
+                continue
+        return None
+
+    def _peer_fetch(self, digest: str):
+        """Try each configured peer for ``digest``: download the segment,
+        validate it, republish it into the LOCAL tiers (so one pod transfer
+        serves this host's later readers too) and attach. Returns the
+        attached ``(payload,)`` or ``None``. A peer that errors is skipped
+        — the pod tier degrades to a local fill, never fails the read."""
+        import urllib.error
+        import urllib.request
+        for peer in self._peers:
+            url = 'http://{}/peercache/{}'.format(peer, digest)
+            tmp = None
+            nbytes = 0
+            try:
+                with urllib.request.urlopen(
+                        url, timeout=self._peer_timeout_s) as resp:
+                    fd, tmp = tempfile.mkstemp(dir=self._path,
+                                               suffix='.peer')
+                    with os.fdopen(fd, 'wb') as out:
+                        while True:
+                            chunk = resp.read(1 << 20)
+                            if not chunk:
+                                break
+                            out.write(chunk)
+                            nbytes += len(chunk)
+                # validate BEFORE publishing: a torn transfer must be
+                # dropped, never served (header + trailer + frame table)
+                _kind, frames, mapping = read_segment(tmp)
+                for frame in frames:
+                    frame.release()
+                mapping.close()
+                self._mem.put_file(digest, tmp)
+            except urllib.error.HTTPError as e:
+                if e.code != 404:    # 404 is an honest peer miss
+                    self._bump('peer_errors', 'shared_peer_errors')
+                continue
+            except (OSError, CorruptSegmentError, ValueError) as e:
+                logger.warning('peer-cache fetch %s failed (degrading to '
+                               'next peer / local fill): %s', url, e)
+                self._bump('peer_errors', 'shared_peer_errors')
+                continue
+            finally:
+                if tmp is not None:
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+            attached = self._try_attach(digest)
+            if attached is not None:
+                self._bump('peer_hits', 'shared_peer_hits')
+                with self._lock:
+                    self._totals['peer_bytes'] += nbytes
+                return attached
+        self._bump('peer_misses', 'shared_peer_misses')
+        return None
+
+    def serve_peers(self, port: int = 0) -> int:
+        """Start this cache root's pod endpoint (``GET /peercache/<digest>``
+        on ``127.0.0.1``) and return the bound port — what other hosts list
+        in their ``peers=``. Idempotent; stopped by :meth:`close`."""
+        with self._lock:
+            server = self._peer_server
+        if server is not None:
+            return server.port
+        server = PeerCacheServer(self, port=port).start()
+        with self._lock:
+            if self._peer_server is None:
+                self._peer_server = server
+                server = None
+        if server is not None:    # lost a start race: keep the first
+            server.stop()
+        return self._peer_server.port
+
     # -- CacheBase -------------------------------------------------------------
 
     def get(self, key: str, fill_cache_func):
@@ -894,34 +1042,77 @@ class SharedRowGroupCache(CacheBase):
             if attached is not None:
                 self._record(hit=True)
                 return attached[0]
-            value = fill_cache_func()
-            self._record(hit=False)
-            try:
-                # chaos hook (docs/robustness.md): the cache-enospc scenario
-                # raises here, exercising the same degrade path a genuinely
-                # full /dev/shm or spill disk takes
-                from petastorm_tpu.faultfs import maybe_inject_cache_fault
-                maybe_inject_cache_fault(digest)
-                kind, frames = _serialize_payload(value)
-                self._mem.put(digest, kind, frames)
-                with self._lock:
-                    self._totals['fills'] += 1
-            except (OSError, pickle.PicklingError, TypeError,
-                    ValueError) as e:
-                # cache publication failures must never fail the read path:
-                # the freshly decoded value is served directly, the event is
-                # counted (shared_put_failures -> ReaderStats -> a named
-                # 'degraded' cause in /healthz), and the pipeline runs on
-                # without the cache tier
-                logger.warning('failed to publish shared-cache segment '
-                               '(degrading to direct decode): %s', e)
-                with self._lock:
-                    self._events['shared_put_failures'] += 1
-                    self._totals['put_failures'] += 1
-            return value
+            if self._peers:
+                return self._pod_fill(digest, fill_cache_func)
+            return self._publish_fill(digest, fill_cache_func)
         finally:
             if got_lock:
                 self._unlock(digest)
+
+    def _publish_fill(self, digest: str, fill_cache_func):
+        """Decode locally and publish — the single-flight fill body. Every
+        ``fills`` increment in the pod comes from here, which is what makes
+        ``sum(fills over roots) == row groups`` a decode-once certificate."""
+        value = fill_cache_func()
+        self._record(hit=False)
+        try:
+            # chaos hook (docs/robustness.md): the cache-enospc scenario
+            # raises here, exercising the same degrade path a genuinely
+            # full /dev/shm or spill disk takes
+            from petastorm_tpu.faultfs import maybe_inject_cache_fault
+            maybe_inject_cache_fault(digest)
+            kind, frames = _serialize_payload(value)
+            self._mem.put(digest, kind, frames)
+            with self._lock:
+                self._totals['fills'] += 1
+        except (OSError, pickle.PicklingError, TypeError,
+                ValueError) as e:
+            # cache publication failures must never fail the read path:
+            # the freshly decoded value is served directly, the event is
+            # counted (shared_put_failures -> ReaderStats -> a named
+            # 'degraded' cause in /healthz), and the pipeline runs on
+            # without the cache tier
+            logger.warning('failed to publish shared-cache segment '
+                           '(degrading to direct decode): %s', e)
+            with self._lock:
+                self._events['shared_put_failures'] += 1
+                self._totals['put_failures'] += 1
+        return value
+
+    def _pod_fill(self, digest: str, fill_cache_func):
+        """A local miss with a pod configured: peers before the object
+        store. Sequential mode tries peers then fills; hedged mode races
+        the peer fetch (primary) against the local decode (hedge, fired
+        after ``peer_hedge_s``) — a once-gate keeps the fill exactly-once
+        even when both sides of the race reach it."""
+        if self._peer_hedge is None:
+            attached = self._peer_fetch(digest)
+            if attached is not None:
+                self._record(hit=False)   # a local miss the pod served
+                return attached[0]
+            return self._publish_fill(digest, fill_cache_func)
+        gate = {'mutex': threading.Lock(), 'done': False, 'value': None}
+
+        def gated_fill():
+            # the gate mutex intentionally blocks the second arrival for
+            # the duration of the fill: it must WAIT for the first fill,
+            # not decode (and count) the same row group again
+            with gate['mutex']:
+                if not gate['done']:
+                    gate['value'] = self._publish_fill(digest,
+                                                       fill_cache_func)
+                    gate['done'] = True
+                return gate['value']
+
+        def peers_then_fill():
+            attached = self._peer_fetch(digest)
+            if attached is not None:
+                self._record(hit=False)
+                return attached[0]
+            return gated_fill()
+        return self._peer_hedge.call(
+            peers_then_fill, hedge_fn=gated_fill,
+            description='peer_fill({})'.format(digest[:8]))
 
     # -- telemetry -------------------------------------------------------------
 
@@ -1011,15 +1202,24 @@ class SharedRowGroupCache(CacheBase):
     # -- lifecycle -------------------------------------------------------------
 
     def close(self) -> None:
-        """Flush counters and release this instance's pins. Idempotent; the
-        piece workers call it from ``shutdown()``. Attached mappings are NOT
-        force-closed — payload views own them refcounted."""
+        """Flush counters, stop the pod endpoint (when served), drain
+        in-flight pod hedge races and release this instance's pins.
+        Idempotent; the piece workers call it from ``shutdown()``. Attached
+        mappings are NOT force-closed — payload views own them
+        refcounted."""
         self._flush_counters()
         with self._lock:
             if self._closed:
                 return
             self._closed = True
             attached, self._attached = self._attached, OrderedDict()
+            server, self._peer_server = self._peer_server, None
+        if self._peer_hedge is not None:
+            # an abandoned race loser may still be mid-fetch/mid-fill; give
+            # it a bounded join before the interpreter starts finalizing
+            self._peer_hedge.drain()
+        if server is not None:
+            server.stop()
         for att in attached.values():
             self._pins.unpin(att.pin_path)
 
@@ -1030,3 +1230,102 @@ class SharedRowGroupCache(CacheBase):
         import shutil
         shutil.rmtree(self._mem.root, ignore_errors=True)
         shutil.rmtree(self._path, ignore_errors=True)
+
+
+# -- pod peer protocol (docs/object_store.md) ----------------------------------
+
+_HEX_DIGITS = frozenset('0123456789abcdef')
+
+
+class PeerCacheServer:
+    """One host's side of the pod cache protocol: ``GET
+    /peercache/<digest>`` returns the raw segment bytes of a locally
+    resident decoded row group (tier 0 before tier 1), 404 on a miss.
+
+    Deliberately minimal — stdlib HTTP on the :class:`DebugServer` plumbing
+    (``ThreadingHTTPServer`` on ``127.0.0.1``, daemon request threads,
+    quiet logs), because the *fetching* side carries all the correctness:
+    every transferred segment is re-validated against its header/trailer/
+    frame table before being republished, so a torn response degrades to a
+    local fill instead of serving garbage. The digest is hex-checked before
+    it touches a filesystem path. Failure semantics: any server-side error
+    is a 500 the client counts as ``peer_errors`` and routes around — a
+    down peer never fails a read, it just costs the pod one extra decode.
+    """
+
+    def __init__(self, cache: SharedRowGroupCache, port: int = 0):
+        self._cache = cache
+        self._requested_port = port
+        self._server = None
+        self._thread: Optional[threading.Thread] = None
+        #: The bound port (differs from the requested one when it was 0).
+        self.port: Optional[int] = None
+
+    def start(self) -> 'PeerCacheServer':
+        if self._server is not None:
+            return self
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet by default
+                logger.debug('peer-cache endpoint: ' + fmt, *args)
+
+            def _reply(self, status: int, body: bytes,
+                       content_type: str = 'text/plain'):
+                self.send_response(status)
+                self.send_header('Content-Type', content_type)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                try:
+                    route = self.path.split('?', 1)[0]
+                    if not route.startswith('/peercache/'):
+                        self._reply(404, b'unknown route; try '
+                                         b'/peercache/<digest>\n')
+                        return
+                    digest = route[len('/peercache/'):]
+                    if not digest or not set(digest) <= _HEX_DIGITS:
+                        # the digest lands in a filesystem path: hex-only,
+                        # no traversal surface
+                        self._reply(400, b'bad digest\n')
+                        return
+                    data = outer._cache.segment_bytes(digest)
+                    if data is None:
+                        self._reply(404, b'miss\n')
+                        return
+                    self._reply(200, data, 'application/octet-stream')
+                # a failed segment read (evicted/truncated mid-request) must
+                # become a 500 the fetching peer counts and routes around —
+                # never a dropped connection or a dead serve loop
+                except Exception as e:  # petalint: disable=exception-hygiene
+                    logger.exception('peer-cache request failed')
+                    try:
+                        self._reply(500, 'error: {}\n'.format(e).encode())
+                    except OSError:
+                        pass
+
+        self._server = ThreadingHTTPServer(
+            ('127.0.0.1', self._requested_port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={'poll_interval': 0.1}, daemon=True,
+            name='petastorm-tpu-peercache-http')
+        self._thread.start()
+        logger.info('petastorm_tpu peer-cache endpoint on '
+                    'http://127.0.0.1:%d/peercache/', self.port)
+        return self
+
+    def stop(self) -> None:
+        server, self._server = self._server, None
+        if server is None:
+            return
+        server.shutdown()
+        server.server_close()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10)

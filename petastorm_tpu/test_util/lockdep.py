@@ -64,6 +64,7 @@ DEFAULT_TARGET_MODULES = (
     'petastorm_tpu.readers.readahead',
     'petastorm_tpu.readers.piece_worker',
     'petastorm_tpu.ops.decode',
+    'petastorm_tpu.objectstore',
 )
 
 

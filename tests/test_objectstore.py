@@ -1,0 +1,511 @@
+"""Tests for the object-store read plane (petastorm_tpu/objectstore.py; see
+docs/object_store.md): footer-driven range planning, the random-access range
+buffer with its fallback-fetch contract, end-to-end parallel ranged row-group
+reads against plain pyarrow reads, the ``remote_read`` knob, filesystem-
+identity-keyed file-handle caching, recorded-trace replay determinism, and
+the pod-tier peer cache protocol (serve / fetch / honest 404 / dead-peer
+degrade)."""
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import faultfs
+from petastorm_tpu.faultfs import FaultInjector, FaultyFilesystem
+from petastorm_tpu.objectstore import (DEFAULT_GAP_BYTES, ParallelRangeReader,
+                                       RangeBuffer, RangePlanner,
+                                       resolve_remote_read)
+from petastorm_tpu.readers.piece_worker import FileHandleCache
+from petastorm_tpu.resilience import ResilientIO, resolve_retry
+from petastorm_tpu.sharedcache import SharedRowGroupCache
+
+
+# -- fake footer metadata (planner unit tests need exact offsets) --------------
+
+class _Chunk:
+    def __init__(self, path_in_schema, data_page_offset,
+                 dictionary_page_offset, total_compressed_size):
+        self.path_in_schema = path_in_schema
+        self.data_page_offset = data_page_offset
+        self.dictionary_page_offset = dictionary_page_offset
+        self.total_compressed_size = total_compressed_size
+
+
+class _RowGroup:
+    def __init__(self, chunks):
+        self._chunks = chunks
+        self.num_columns = len(chunks)
+
+    def column(self, i):
+        return self._chunks[i]
+
+
+class _Meta:
+    def __init__(self, chunks):
+        self._rg = _RowGroup(chunks)
+
+    def row_group(self, _i):
+        return self._rg
+
+
+class TestRangePlanner:
+    def test_dictionary_page_starts_the_chunk(self):
+        meta = _Meta([_Chunk('a', data_page_offset=500,
+                             dictionary_page_offset=400,
+                             total_compressed_size=300)])
+        assert RangePlanner.column_chunk_ranges(meta, 0) == [(400, 300)]
+
+    def test_absent_or_bogus_dictionary_offset_ignored(self):
+        # pyarrow reports None when there is no dictionary page; 0 and an
+        # offset past the data pages are footer garbage, not a start
+        for dict_off in (None, 0, 900):
+            meta = _Meta([_Chunk('a', 500, dict_off, 300)])
+            assert RangePlanner.column_chunk_ranges(meta, 0) == [(500, 300)]
+
+    def test_column_selection_by_top_level_name(self):
+        meta = _Meta([_Chunk('a', 100, None, 50),
+                      _Chunk('b.list.item', 200, None, 50),
+                      _Chunk('c', 300, None, 50)])
+        assert RangePlanner.column_chunk_ranges(meta, 0, columns=['b']) \
+            == [(200, 50)]
+        assert RangePlanner.column_chunk_ranges(meta, 0) \
+            == [(100, 50), (200, 50), (300, 50)]
+
+    def test_empty_chunk_skipped(self):
+        meta = _Meta([_Chunk('a', 100, None, 0), _Chunk('b', 200, None, 10)])
+        assert RangePlanner.column_chunk_ranges(meta, 0) == [(200, 10)]
+
+    def test_merge_within_gap(self):
+        planner = RangePlanner(gap_bytes=64, max_range_bytes=1 << 20)
+        assert planner.merge([(0, 100), (164, 100)]) == [(0, 264)]
+        assert planner.merge([(0, 100), (165, 100)]) == [(0, 100), (165, 100)]
+
+    def test_merge_overlapping_keeps_the_union(self):
+        planner = RangePlanner(gap_bytes=0, max_range_bytes=1 << 20)
+        assert planner.merge([(0, 100), (50, 20)]) == [(0, 100)]
+
+    def test_split_above_max_range(self):
+        planner = RangePlanner(gap_bytes=0, max_range_bytes=100)
+        assert planner.merge([(0, 250)]) == [(0, 100), (100, 100), (200, 50)]
+
+    def test_wasted_bytes_is_the_coalescing_price(self):
+        planner = RangePlanner(gap_bytes=64, max_range_bytes=1 << 20)
+        chunks = [(0, 100), (150, 100)]
+        plan = planner.merge(chunks)
+        assert plan == [(0, 250)]
+        assert RangePlanner.wasted_bytes(chunks, plan) == 50
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError, match='gap_bytes'):
+            RangePlanner(gap_bytes=-1)
+        with pytest.raises(ValueError, match='max_range_bytes'):
+            RangePlanner(max_range_bytes=0)
+
+
+class TestRangeBuffer:
+    def _fetcher(self, backing, calls):
+        def fetch(offset, length):
+            calls.append((offset, length))
+            return backing[offset:offset + length]
+        return fetch
+
+    def test_reads_span_segments_and_fetch_only_gaps(self):
+        backing = bytes(range(256)) * 4
+        calls = []
+        fallbacks = []
+        buf = RangeBuffer(len(backing), self._fetcher(backing, calls),
+                          on_fallback=fallbacks.append)
+        buf.insert(0, backing[0:100])
+        buf.insert(300, backing[300:400])
+        buf.seek(50)
+        assert buf.read(400) == backing[50:450]
+        # exactly the uncovered sub-ranges were fetched: up to the next
+        # known segment, then past it
+        assert calls == [(100, 200), (400, 50)]
+        assert fallbacks == [200, 50]
+
+    def test_covered_read_never_fetches(self):
+        backing = b'x' * 1000
+        calls = []
+        buf = RangeBuffer(1000, self._fetcher(backing, calls))
+        buf.insert(0, backing)
+        assert buf.read(-1) == backing
+        assert calls == []
+
+    def test_seek_whence_and_clamping(self):
+        buf = RangeBuffer(100, lambda off, n: b'\0' * n)
+        assert buf.seek(10) == 10
+        assert buf.seek(5, 1) == 15
+        assert buf.seek(-20, 2) == 80
+        assert buf.seek(-500, 1) == 0
+        assert buf.seek(500) == 100
+        assert buf.tell() == 100
+        with pytest.raises(ValueError):
+            buf.seek(0, 3)
+
+    def test_duplicate_insert_keeps_the_longer_segment(self):
+        buf = RangeBuffer(100, lambda off, n: b'\0' * n)
+        buf.insert(0, b'ab')
+        buf.insert(0, b'a')
+        buf.insert(0, b'abcd')
+        buf.seek(0)
+        assert buf.read(4) == b'abcd'
+
+    def test_file_protocol(self):
+        buf = RangeBuffer(10, lambda off, n: b'\0' * n)
+        assert buf.readable() and buf.seekable() and not buf.writable()
+        assert buf.size() == 10
+        assert not buf.closed
+        buf.close()
+        assert buf.closed
+
+
+# -- end-to-end ranged reads over a real parquet file --------------------------
+
+@pytest.fixture(scope='module')
+def parquet_store(tmp_path_factory):
+    """One multi-row-group parquet file (dict-encoded strings + numerics)
+    plus the local fsspec filesystem to read it through."""
+    import fsspec
+    path = tmp_path_factory.mktemp('objectstore') / 'part_0.parquet'
+    n = 60
+    table = pa.table({
+        'idx': np.arange(n, dtype=np.int64),
+        'value': np.arange(n, dtype=np.float64) * 0.5,
+        'label': pa.array(['label_{}'.format(i % 7) for i in range(n)]),
+    })
+    pq.write_table(table, str(path), row_group_size=20)
+    return fsspec.filesystem('file'), str(path)
+
+
+def _counting_fs(inner):
+    """A FaultyFilesystem with the no-op scenario: pure read/byte counting."""
+    return FaultyFilesystem(inner, FaultInjector('none', seed=0))
+
+
+class _FlakyOpenFS:
+    """Raises OSError on ``open`` after the first ``allow`` calls."""
+
+    def __init__(self, inner, allow):
+        self._inner = inner
+        self._allow = allow
+
+    def open(self, *args, **kwargs):
+        self._allow -= 1
+        if self._allow < 0:
+            raise OSError('store exploded')
+        return self._inner.open(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TestParallelRangeReader:
+    def test_matches_plain_read(self, parquet_store):
+        fs, path = parquet_store
+        reader = ParallelRangeReader(fs)
+        plain = pq.ParquetFile(path)
+        for rg in range(plain.metadata.num_row_groups):
+            assert reader.read_row_group(path, rg).equals(
+                plain.read_row_group(rg))
+
+    def test_column_subset(self, parquet_store):
+        fs, path = parquet_store
+        reader = ParallelRangeReader(fs)
+        table = reader.read_row_group(path, 1, columns=['label', 'idx'])
+        expected = pq.ParquetFile(path).read_row_group(
+            1, columns=['label', 'idx'])
+        assert table.equals(expected)
+
+    def test_single_flight_path(self, parquet_store):
+        fs, path = parquet_store
+        reader = ParallelRangeReader(fs, max_in_flight=1)
+        assert reader.read_row_group(path, 0).equals(
+            pq.ParquetFile(path).read_row_group(0))
+
+    def test_footer_cached_across_reads(self, parquet_store):
+        fs, path = parquet_store
+        counting = _counting_fs(fs)
+        reader = ParallelRangeReader(counting)
+        reader.file_metadata(path)
+        after_first = counting.read_calls
+        assert after_first > 0
+        size, metadata, (tail_offset, tail) = reader.file_metadata(path)
+        assert counting.read_calls == after_first, 'footer must be cached'
+        assert tail_offset + len(tail) == size
+        assert metadata.num_row_groups == 3
+
+    def test_events_drain(self, parquet_store):
+        fs, path = parquet_store
+        reader = ParallelRangeReader(fs)
+        reader.read_row_group(path, 0)
+        events = reader.take_events()
+        assert events['io_ranged_reads'] == 1
+        assert events['io_range_requests'] >= 1
+        assert events['io_range_bytes'] > 0
+        assert reader.take_events() == {}
+
+    def test_fetch_row_group_bytes_is_the_planned_payload(self, parquet_store):
+        fs, path = parquet_store
+        reader = ParallelRangeReader(fs)
+        _size, metadata, _tail = reader.file_metadata(path)
+        planner = RangePlanner(gap_bytes=DEFAULT_GAP_BYTES)
+        planned = sum(n for _, n in planner.plan(metadata, 0))
+        assert reader.fetch_row_group_bytes(path, 0) == planned > 0
+
+    def test_not_a_parquet_file_fails_fast(self, parquet_store, tmp_path):
+        fs, _path = parquet_store
+        bogus = tmp_path / 'not_parquet.bin'
+        bogus.write_bytes(b'not a parquet file at all' * 10)
+        reader = ParallelRangeReader(fs)
+        with pytest.raises(IOError, match='magic'):
+            reader.file_metadata(str(bogus))
+
+    def test_fetch_thread_errors_propagate(self, parquet_store):
+        fs, path = parquet_store
+        # footer resolves (one open), every planned range fetch then fails
+        reader = ParallelRangeReader(_FlakyOpenFS(fs, allow=1))
+        with pytest.raises(OSError, match='store exploded'):
+            reader.read_row_group(path, 0)
+
+    def test_per_range_retry_recovers(self, parquet_store):
+        fs, path = parquet_store
+        injector = FaultInjector('transient-errors', seed=3, error_rate=1.0)
+        faulty = FaultyFilesystem(fs, injector)
+        resilience = ResilientIO(dict(resolve_retry(True),
+                                      initial_backoff_s=0.001))
+        reader = ParallelRangeReader(faulty, resilience=resilience)
+        table = reader.read_row_group(path, 0)
+        assert table.equals(pq.ParquetFile(path).read_row_group(0))
+        assert injector.injected.get('transient_error', 0) >= 1
+        assert resilience.take_events().get('io_retries', 0) >= 1
+
+
+class TestRemoteReadKnob:
+    def test_resolution(self):
+        assert resolve_remote_read(None) is None
+        assert resolve_remote_read('auto') is None
+        for mode in ('ranged', 'prebuffer', 'serial'):
+            assert resolve_remote_read(mode) == mode
+
+    def test_typo_fails(self):
+        with pytest.raises(ValueError, match='remote_read'):
+            resolve_remote_read('rangedd')
+
+    def test_factory_fails_fast_on_typo(self, scalar_dataset):
+        from petastorm_tpu.reader import make_reader
+        with pytest.raises(ValueError, match='remote_read'):
+            make_reader(scalar_dataset.url, remote_read='coalesced')
+
+    def test_ranged_reader_end_to_end(self, scalar_dataset):
+        from petastorm_tpu.reader import make_batch_reader
+        ids = []
+        with make_batch_reader(scalar_dataset.url, remote_read='ranged',
+                               num_epochs=1, workers_count=2) as reader:
+            for batch in reader:
+                ids.extend(int(i) for i in batch.id)
+        assert sorted(ids) == sorted(int(r['id'])
+                                     for r in scalar_dataset.data)
+
+
+class _Handle:
+    def __init__(self, path):
+        self.path = path
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+class TestFileHandleCacheIdentity:
+    def test_identity_partitions_the_cache(self):
+        opened = []
+
+        def open_fn(path):
+            handle = _Handle(path)
+            opened.append(handle)
+            return handle
+
+        identity = {'fs': 'clean'}
+        cache = FileHandleCache(open_fn, fs_key=lambda: identity['fs'])
+        first = cache.get('/d/p.parquet')
+        assert cache.get('/d/p.parquet') is first
+        # the filesystem the open_fn resolves to changed (chaos wrap): the
+        # cached clean handle must NOT be served for the wrapped identity
+        identity['fs'] = 'chaos'
+        second = cache.get('/d/p.parquet')
+        assert second is not first
+        assert len(opened) == 2 and not first.closed
+
+    def test_invalidate_drops_every_identity(self):
+        identity = {'fs': 'a'}
+        cache = FileHandleCache(_Handle, fs_key=lambda: identity['fs'])
+        first = cache.get('/d/p.parquet')
+        identity['fs'] = 'b'
+        second = cache.get('/d/p.parquet')
+        assert '/d/p.parquet' in cache and len(cache) == 2
+        cache.invalidate('/d/p.parquet')
+        assert first.closed and second.closed
+        assert '/d/p.parquet' not in cache and len(cache) == 0
+
+
+# -- recorded-trace replay -----------------------------------------------------
+
+class TestTraceReplay:
+    def test_builtin_trace_loads_and_validates(self):
+        trace = faultfs.load_trace('s3-us-east-1')
+        assert trace['first_byte_latency_s']
+        assert trace['bandwidth_bytes_per_s']
+
+    def test_unknown_trace_fails(self):
+        with pytest.raises(ValueError, match='unknown trace'):
+            faultfs.trace_path('no-such-trace')
+
+    def test_malformed_trace_fails(self, tmp_path):
+        bad = tmp_path / 'bad.json'
+        bad.write_text('{"first_byte_latency_s": [], '
+                       '"bandwidth_bytes_per_s": [1.0]}')
+        with pytest.raises(ValueError, match='first_byte_latency_s'):
+            faultfs.load_trace(str(bad))
+
+    def test_trace_replay_requires_a_trace(self):
+        with pytest.raises(ValueError, match='trace-replay needs'):
+            FaultInjector('trace-replay', seed=0)
+
+    def test_parse_chaos_string_valued_param(self):
+        injector = faultfs.parse_chaos(
+            'trace-replay:5:trace=s3-us-east-1,latency_scale=0.5')
+        assert injector.scenario == 'trace-replay'
+        assert injector.seed == 5
+        assert injector.params['trace'] == 's3-us-east-1'
+        assert injector.params['latency_scale'] == pytest.approx(0.5)
+
+    def test_ranged_reads_replay_deterministically(self, parquet_store):
+        fs, path = parquet_store
+
+        def run():
+            injector = FaultInjector('trace-replay', seed=11,
+                                     trace='s3-us-east-1',
+                                     latency_scale=0.001,
+                                     bandwidth_scale=1000.0)
+            reader = ParallelRangeReader(FaultyFilesystem(fs, injector))
+            for rg in range(3):
+                reader.read_row_group(path, rg)
+            return (dict(injector.injected),
+                    {k: round(v, 9) for k, v in injector.injected_s.items()})
+
+        first, second = run(), run()
+        assert first == second
+        assert first[0]['trace_reads'] > 0
+        assert first[1]['trace_latency_s'] > 0
+
+    def test_same_range_redraws_on_retry(self):
+        # a hedge/retry of the SAME range must re-draw (occurrence bump):
+        # the two replayed delays are independent samples
+        def tally(n_calls):
+            injector = FaultInjector('trace-replay', seed=2,
+                                     trace='s3-us-east-1',
+                                     latency_scale=1e-6,
+                                     bandwidth_scale=1e9)
+            for _ in range(n_calls):
+                injector.trace_delay('/d/p.parquet', 4096, 1024)
+            return injector.injected_s['trace_latency_s']
+
+        once, twice = tally(1), tally(2)
+        assert twice > once
+        assert twice != pytest.approx(2 * once)
+
+
+# -- pod-tier peer cache protocol ----------------------------------------------
+
+def _mk_cache(tmp_path, name, **kwargs):
+    return SharedRowGroupCache(str(tmp_path / name), 1 << 24,
+                               mem_dir=str(tmp_path / (name + '_mem')),
+                               **kwargs)
+
+
+def _payload(i):
+    return {'a': np.full(1000, i, dtype=np.int64)}
+
+
+class TestPeerCache:
+    def test_peer_fetch_skips_the_local_fill(self, tmp_path):
+        served = _mk_cache(tmp_path, 'host_a')
+        try:
+            value = served.get('rg0', lambda: _payload(7))
+            np.testing.assert_array_equal(value['a'], _payload(7)['a'])
+            port = served.serve_peers()
+            assert served.serve_peers() == port, 'serve_peers is idempotent'
+            fetcher = _mk_cache(tmp_path, 'host_b',
+                                peers=['127.0.0.1:{}'.format(port)])
+            try:
+                def never_fill():
+                    raise AssertionError('peer hit must not decode locally')
+                got = fetcher.get('rg0', never_fill)
+                np.testing.assert_array_equal(got['a'], _payload(7)['a'])
+                counters = fetcher.counters()
+                assert counters['peer_hits'] == 1
+                assert counters['fills'] == 0
+                assert counters['peer_bytes'] > 0
+                # the fetched segment was republished locally: the next
+                # read attaches without touching the pod
+                fetcher.get('rg0', never_fill)
+                assert fetcher.counters()['peer_hits'] == 1
+            finally:
+                fetcher.close()
+        finally:
+            served.close()
+
+    def test_peer_404_is_an_honest_miss(self, tmp_path):
+        served = _mk_cache(tmp_path, 'host_a')
+        try:
+            port = served.serve_peers()
+            fetcher = _mk_cache(tmp_path, 'host_b',
+                                peers=['127.0.0.1:{}'.format(port)])
+            try:
+                got = fetcher.get('missing', lambda: _payload(3))
+                np.testing.assert_array_equal(got['a'], _payload(3)['a'])
+                counters = fetcher.counters()
+                assert counters['peer_misses'] == 1
+                assert counters['peer_errors'] == 0
+                assert counters['fills'] == 1
+            finally:
+                fetcher.close()
+        finally:
+            served.close()
+
+    def test_dead_peer_degrades_to_local_fill(self, tmp_path):
+        fetcher = _mk_cache(tmp_path, 'host_b', peer_timeout_s=0.5,
+                            peers=['127.0.0.1:9'])   # nothing listens there
+        try:
+            got = fetcher.get('rg0', lambda: _payload(5))
+            np.testing.assert_array_equal(got['a'], _payload(5)['a'])
+            counters = fetcher.counters()
+            assert counters['peer_errors'] == 1
+            assert counters['fills'] == 1
+        finally:
+            fetcher.close()
+
+    def test_global_counters_sum_the_pod_certificate(self, tmp_path):
+        served = _mk_cache(tmp_path, 'host_a')
+        fetcher = None
+        try:
+            served.get('rg0', lambda: _payload(1))
+            port = served.serve_peers()
+            fetcher = _mk_cache(tmp_path, 'host_b',
+                                peers=['127.0.0.1:{}'.format(port)])
+            fetcher.get('rg0', lambda: _payload(1))
+        finally:
+            if fetcher is not None:
+                fetcher.close()
+            served.close()
+        pod = {}
+        for name in ('host_a', 'host_b'):
+            for key, n in SharedRowGroupCache.global_counters(
+                    str(tmp_path / name)).items():
+                pod[key] = pod.get(key, 0) + n
+        assert pod['fills'] == 1, 'one decode pod-wide'
+        assert pod['peer_hits'] == 1

@@ -237,6 +237,43 @@ class TestHedgedRead:
         assert hedge.threshold_s() is None
         assert hedge.call(lambda: 42) == 42
 
+    def test_loser_drained_under_trace_replay(self):
+        """A hedge fired under the replayed object-store trace wins against
+        a primary still blocked mid-range-read; shutdown's drain() must join
+        the abandoned loser (no thread left inside a read when the
+        interpreter finalizes) and the counters must show the win."""
+        injector = FaultInjector('trace-replay', seed=3,
+                                 trace='s3-us-east-1', latency_scale=0.001,
+                                 bandwidth_scale=1000.0)
+        io = ResilientIO(None, dict(resilience.DEFAULT_HEDGE,
+                                    threshold_s=0.01))
+        release = threading.Event()
+
+        def stuck_primary():
+            release.wait(10.0)   # a range read wedged at the store
+            return 'primary'
+
+        def traced_hedge():
+            injector.trace_delay('/d/part-0.parquet', 4096, 65536)
+            return 'hedge'
+
+        assert io.read(stuck_primary, hedge_fn=traced_hedge) == 'hedge'
+        assert injector.injected['trace_reads'] == 1
+        events = io.take_events()
+        assert events.get('io_hedges') == 1
+        assert events.get('io_hedge_wins') == 1
+
+        def race_threads():
+            return [t for t in threading.enumerate()
+                    if t.name.startswith('petastorm-tpu-hedge-')]
+
+        # the loser is abandoned-but-running until its blocking call returns
+        assert any(t.name == 'petastorm-tpu-hedge-primary'
+                   for t in race_threads())
+        release.set()
+        io.drain()
+        assert race_threads() == [], 'drain must join every race thread'
+
 
 class TestResilientIO:
     def test_retry_then_success_counts_drain(self):
