@@ -145,7 +145,8 @@ class MonotonicClockRule(Rule):
              'petastorm_tpu/autotune.py', 'petastorm_tpu/workers/*',
              'petastorm_tpu/readers/readahead.py',
              'petastorm_tpu/resilience.py', 'petastorm_tpu/faultfs.py',
-             'petastorm_tpu/ops/decode.py', 'petastorm_tpu/objectstore.py')
+             'petastorm_tpu/ops/decode.py', 'petastorm_tpu/objectstore.py',
+             'petastorm_tpu/podobs.py')
     _WALL_CALLS = ('time.time', 'datetime.now', 'datetime.datetime.now',
                    'datetime.utcnow', 'datetime.datetime.utcnow')
 

@@ -45,7 +45,8 @@ DEFAULT_DOCS = ('docs/benchmarks.md', 'docs/transport.md',
                 'docs/readahead.md', 'docs/tracing.md', 'docs/health.md',
                 'docs/lineage.md', 'docs/cache.md', 'docs/profiling.md',
                 'docs/decode.md', 'docs/latency.md', 'docs/autotune.md',
-                'docs/robustness.md', 'docs/object_store.md')
+                'docs/robustness.md', 'docs/object_store.md',
+                'docs/pod_observability.md')
 MIN_ANNOTATIONS = 30
 
 #: Artifacts that MUST be quoted by at least one annotation across the
@@ -60,11 +61,13 @@ MIN_ANNOTATIONS = 30
 #: mis-tuned-recovery + steady-guard record; round-16 adds BENCH_r16, the
 #: chaos hedged-vs-unhedged tail-latency + clean-path-overhead record;
 #: round-18 adds BENCH_r18, the object-store ranged-read + recorded-trace
-#: + pod-dedup record).
+#: + pod-dedup record; round-19 adds BENCH_r19, the pod-observability
+#: overhead + K-host merged-certificate record).
 REQUIRED_ARTIFACTS = ('BENCH_r06.json', 'BENCH_r07.json', 'BENCH_r08.json',
                       'BENCH_r09.json', 'BENCH_r10.json', 'BENCH_r11.json',
                       'BENCH_r12.json', 'BENCH_r13.json', 'BENCH_r14.json',
-                      'BENCH_r15.json', 'BENCH_r16.json', 'BENCH_r18.json')
+                      'BENCH_r15.json', 'BENCH_r16.json', 'BENCH_r18.json',
+                      'BENCH_r19.json')
 
 def check_artifacts_intact(root: str = ROOT):
     """Reject any committed ``BENCH_*.json`` that carries a ``parsed`` key

@@ -344,6 +344,31 @@ def _objectstore_entries(artifact, round_no, blob):
     return entries
 
 
+def _podobs_entries(artifact, round_no, blob):
+    """Entries from the pod-observability benchmark (r19): the
+    podobs-off baseline ranged rate under the recorded trace, and the
+    podobs-on rate whose %-of-baseline IS the default-on overhead claim
+    (its roofline context)."""
+    entries = []
+    overhead = blob.get('overhead') or {}
+    trace = blob.get('trace') or {}
+    config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+              'rows': blob.get('rows'), 'trace': trace.get('name'),
+              'seed': trace.get('seed'), 'pairs': overhead.get('pairs')}
+    baseline = overhead.get('baseline_items_per_s')
+    if isinstance(baseline, (int, float)):
+        entries.append(_entry(artifact, round_no,
+                              'podobs.baseline_items_per_s', config,
+                              baseline))
+    on_rate = overhead.get('podobs_on_items_per_s')
+    if isinstance(on_rate, (int, float)):
+        roof = blob.get('roofline') or {}
+        entries.append(_entry(artifact, round_no,
+                              'podobs.observed_items_per_s', config, on_rate,
+                              roofline_pct=roof.get('roofline_pct')))
+    return entries
+
+
 def _shared_cache_entries(artifact, round_no, blob):
     """Entries from the shared-cache protocol record (r11): the measured
     serial roofline and the aggregate fleet rate."""
@@ -397,6 +422,8 @@ def normalize_artifact(name: str, blob: dict):
         entries.extend(_chaos_entries(name, round_no, payload))
     elif payload.get('benchmark', '') == 'object_store':
         entries.extend(_objectstore_entries(name, round_no, payload))
+    elif payload.get('benchmark', '') == 'podobs':
+        entries.extend(_podobs_entries(name, round_no, payload))
     elif 'baseline_items_per_s' in payload:
         entries.extend(_overhead_entries(name, round_no, payload))
     elif 'shared' in payload and 'roofline' in payload:

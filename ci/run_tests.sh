@@ -95,6 +95,12 @@ PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_objectstore.py -q
 echo '== object-store quick bench (serial/prebuffer/ranged under the recorded trace + pod dedup) =='
 python -m petastorm_tpu.benchmark.object_store --quick
 
+echo '== pod-observability quick checks (snapshot/merge/certificate, trace headers, kill switch; lockdep on) =='
+PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_podobs.py -q
+
+echo '== pod-observability quick bench (overhead A/B under the recorded trace + K-host merged certificate) =='
+JAX_PLATFORMS=cpu python -m petastorm_tpu.benchmark.podobs --quick
+
 echo '== profiler quick checks (attribution, calibration cache, advisor, /profile) =='
 python -m pytest tests/test_profiler.py -q
 

@@ -17,7 +17,7 @@ __all__ = ['make_reader', 'make_batch_reader', 'make_columnar_reader',
            'make_jax_loader', 'make_dataset_converter', 'materialize_dataset',
            'CoverageAuditor', 'Provenance', 'SharedRowGroupCache',
            'LatencyHistogram', 'SLOMonitor',
-           'PipelineController',
+           'PipelineController', 'PodObserver',
            'RetryPolicy', 'HedgedRead', 'FaultInjector',
            '__version__']
 
@@ -57,6 +57,9 @@ def __getattr__(name):
     if name == 'PipelineController':
         from petastorm_tpu.autotune import PipelineController
         return PipelineController
+    if name == 'PodObserver':
+        from petastorm_tpu.podobs import PodObserver
+        return PodObserver
     if name in ('RetryPolicy', 'HedgedRead'):
         from petastorm_tpu import resilience
         return getattr(resilience, name)

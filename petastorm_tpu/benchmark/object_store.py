@@ -373,10 +373,43 @@ def _pod_dedup(url: str, tmpdir: str, k_hosts: int, readers_per_host: int,
         for server in servers:
             server.close()
 
+    # the PRODUCTION aggregation path (docs/pod_observability.md): each
+    # root serves /observe/snapshot and a PodObserver polls + merges —
+    # exactly what a real pod's aggregator runs; the hand-rolled
+    # global_counters sums stay below as an independent cross-check
+    from petastorm_tpu.health import DebugServer
+    from petastorm_tpu.podobs import PodObserver, make_observe_fn
+    obs_servers = []
+    try:
+        for i, root in enumerate(roots):
+            obs = DebugServer(
+                lambda: {'state': 'healthy'},
+                observe_fn=make_observe_fn(
+                    cache_counters_fn=(
+                        lambda root=root:
+                        SharedRowGroupCache.global_counters(root)),
+                    host='pod_host_{}'.format(i)))
+            obs.start()
+            obs_servers.append(obs)
+        observer = PodObserver(
+            ['127.0.0.1:{}'.format(obs.port) for obs in obs_servers],
+            expected_row_groups=n_groups)
+        pod_report = observer.report()
+    finally:
+        for obs in obs_servers:
+            obs.stop()
+    certificate = pod_report['certificate']
+
     per_host = [SharedRowGroupCache.global_counters(root) for root in roots]
     fills = sum(c.get('fills', 0) for c in per_host)
     peer_hits = sum(c.get('peer_hits', 0) for c in per_host)
     peer_errors = sum(c.get('peer_errors', 0) for c in per_host)
+    assert certificate['fills'] == fills, (
+        'PodObserver-merged fills ({}) disagree with the hand-summed '
+        'global_counters ({})'.format(certificate['fills'], fills))
+    assert certificate['peer_hits'] == peer_hits, (
+        'PodObserver-merged peer_hits ({}) disagree with the hand-summed '
+        'global_counters ({})'.format(certificate['peer_hits'], peer_hits))
     total_samples = cold['samples'] + sum(h['samples'] for h in warm_hosts)
     total_wall = cold['wall_s'] + warm_wall
     aggregate = total_samples / total_wall if total_wall else 0.0
@@ -385,6 +418,9 @@ def _pod_dedup(url: str, tmpdir: str, k_hosts: int, readers_per_host: int,
         'readers_per_host': readers_per_host,
         'protocol': 'staged: cold host fills once, remaining hosts '
                     'peer-attach concurrently (sequential peer mode)',
+        'aggregation': 'PodObserver poll of per-root /observe/snapshot '
+                       'endpoints, cross-checked against hand-summed '
+                       'global_counters',
         'baseline_samples_per_sec': baseline['samples_per_sec'],
         'cold_host': cold,
         'warm_hosts': warm_hosts,
@@ -396,7 +432,8 @@ def _pod_dedup(url: str, tmpdir: str, k_hosts: int, readers_per_host: int,
         'peer_errors': peer_errors,
         'row_groups': n_groups,
         'per_host_counters': per_host,
-        'decoded_once_pod_wide': fills == n_groups,
+        'certificate': certificate,
+        'decoded_once_pod_wide': bool(certificate.get('ok')),
     }
 
 

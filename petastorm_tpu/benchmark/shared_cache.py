@@ -190,9 +190,33 @@ def run_shared_cache_bench(quick: bool = False, check: bool = True,
         # 2. the shared fleet (cold cache)
         shared = _run_fleet(url, k_readers, lambda i: shared_kwargs)
 
-        # 3. decode-once proof from the cross-process counters
+        # 3. decode-once proof through the PRODUCTION aggregation path
+        # (docs/pod_observability.md): the cache root serves
+        # /observe/snapshot and a PodObserver polls + certifies; the
+        # hand-rolled global_counters read stays as an independent
+        # cross-check of the merged totals
+        from petastorm_tpu.health import DebugServer
+        from petastorm_tpu.podobs import PodObserver, make_observe_fn
         from petastorm_tpu.sharedcache import SharedRowGroupCache
+        obs = DebugServer(
+            lambda: {'state': 'healthy'},
+            observe_fn=make_observe_fn(
+                cache_counters_fn=(
+                    lambda: SharedRowGroupCache.global_counters(cache_root)),
+                host='shared_cache_host'))
+        obs.start()
+        try:
+            observer = PodObserver(['127.0.0.1:{}'.format(obs.port)],
+                                   expected_row_groups=n_groups)
+            pod_report = observer.report()
+        finally:
+            obs.stop()
+        certificate = pod_report['certificate']
         counters = SharedRowGroupCache.global_counters(cache_root)
+        assert certificate['fills'] == counters.get('fills', -1), (
+            'PodObserver-merged fills ({}) disagree with the hand-read '
+            'global_counters ({})'.format(certificate['fills'],
+                                          counters.get('fills')))
 
         # 4. baseline: K readers, K independent local-disk caches (each
         # decodes everything and ALSO pays the cache write — today's story)
@@ -236,7 +260,8 @@ def run_shared_cache_bench(quick: bool = False, check: bool = True,
             },
             'speedup_aggregate': round(speedup, 2),
             'shared_counters': counters,
-            'decoded_once': counters.get('fills', -1) == n_groups,
+            'certificate': certificate,
+            'decoded_once': bool(certificate.get('ok')),
             'expected_hits': expected_hits,
         }
         if check:

@@ -87,6 +87,15 @@ HEALTHY, DEGRADED, STARVING, STALLED = ('healthy', 'degraded', 'starving',
 IDLE_STAGES = frozenset({'idle', 'done', 'stopped', 'backpressured',
                          'starting'})
 
+#: Read-plane tail thresholds for NAMING the slow side (not inferring it):
+#: a planned object-store range fetch whose p99 exceeds this is a slow
+#: store; a shared-cache peer fetch (one LAN HTTP round trip + a segment
+#: read) whose p99 exceeds this is a slow peer. Both feed
+#: :func:`bottleneck_signals` from the ``io_range_p99_s`` /
+#: ``peer_fetch_p99_s`` snapshot keys (docs/pod_observability.md).
+SLOW_RANGE_FETCH_P99_S = 1.0
+SLOW_PEER_FETCH_P99_S = 0.25
+
 
 def heartbeats_enabled() -> bool:
     """The :data:`HEALTH_ENV_VAR` gate (default on)."""
@@ -254,9 +263,32 @@ def bottleneck_signals(snapshot: dict) -> dict:
         bottleneck = 'balanced'
         hint = ('io and decode are comparable: io_readahead overlaps them '
                 'for up to 2x; workers_count scales both')
+    # name the slow side of the read plane when its own latency stage says
+    # so — "io-bound" alone cannot distinguish a slow object store from a
+    # slow peer cache, but the io_range/peer_fetch histograms can
+    io_range_p99 = snapshot.get('io_range_p99_s') or 0.0
+    peer_fetch_p99 = snapshot.get('peer_fetch_p99_s') or 0.0
+    slow_object_store = bool(io_range_p99 >= SLOW_RANGE_FETCH_P99_S)
+    slow_peer_cache = bool(peer_fetch_p99 >= SLOW_PEER_FETCH_P99_S)
+    if slow_object_store and bottleneck in ('io', 'balanced'):
+        hint = ('the OBJECT STORE is the slow side: range-fetch p99 is '
+                '{:.3f}s (>= {:.2f}s) — check the store/network before '
+                'touching pipeline knobs; hedging (hedge_ms) clips this '
+                'tail (docs/object_store.md)'.format(
+                    io_range_p99, SLOW_RANGE_FETCH_P99_S))
+    if slow_peer_cache:
+        hint += ('; a PEER CACHE host is slow: peer-fetch p99 is {:.3f}s '
+                 '(>= {:.2f}s) — use /podmetrics to see which host, and '
+                 'peer_hedge_s to route around it '
+                 '(docs/pod_observability.md)'.format(
+                     peer_fetch_p99, SLOW_PEER_FETCH_P99_S))
     return {'bottleneck': bottleneck, 'hint': hint, 'io_s': io_s,
             'decode_s': decode_s, 'queue_wait_p50_s': qw_p50,
-            'queue_wait_p99_s': qw_p99, 'tail_stall': tail_stall}
+            'queue_wait_p99_s': qw_p99, 'tail_stall': tail_stall,
+            'io_range_p99_s': io_range_p99,
+            'peer_fetch_p99_s': peer_fetch_p99,
+            'slow_object_store': slow_object_store,
+            'slow_peer_cache': slow_peer_cache}
 
 
 def degradation_causes(snapshot: dict) -> List[str]:
@@ -590,6 +622,18 @@ class DebugServer:
       delta, the aggregate model error, quarantines, and the current knob
       state. 404 when the reader runs without a controller (autotune off or
       kill-switched).
+    - ``GET /observe/snapshot`` — the per-host pod-observability surface
+      (:func:`petastorm_tpu.podobs.make_observe_fn`): stats counters, raw
+      latency-histogram bucket states, health verdict + degraded causes,
+      SLO burn, coverage digest, shared-cache counters, span tail, and the
+      host's monotonic clock reading. The response carries the
+      ``X-Petastorm-Trace`` echo and ``X-Petastorm-Clock-S`` headers so an
+      aggregator can estimate this host's clock offset. 404 when the pod
+      plane is off (``PETASTORM_TPU_PODOBS=0``) or unwired.
+    - ``GET /podmetrics`` — the merged pod report
+      (:meth:`petastorm_tpu.podobs.PodObserver.report`) when this host
+      acts as the aggregator (``PETASTORM_TPU_PODOBS_PEERS``); 404
+      otherwise.
     - ``GET /stacks`` — plain-text stack dump of every in-process thread.
 
     Requests are served on daemon threads (``ThreadingHTTPServer``);
@@ -604,7 +648,9 @@ class DebugServer:
                  coverage_fn: Optional[Callable[[], dict]] = None,
                  profile_fn: Optional[Callable[[], dict]] = None,
                  slo_fn: Optional[Callable[[], dict]] = None,
-                 autotune_fn: Optional[Callable[[], dict]] = None):
+                 autotune_fn: Optional[Callable[[], dict]] = None,
+                 observe_fn: Optional[Callable[[], dict]] = None,
+                 podmetrics_fn: Optional[Callable[[], dict]] = None):
         self._evaluate_fn = evaluate_fn
         self._snapshot_fn = snapshot_fn or (lambda: {})
         self._heartbeats_fn = heartbeats_fn or (lambda: {})
@@ -612,6 +658,8 @@ class DebugServer:
         self._profile_fn = profile_fn
         self._slo_fn = slo_fn
         self._autotune_fn = autotune_fn
+        self._observe_fn = observe_fn
+        self._podmetrics_fn = podmetrics_fn
         self._requested_port = port
         self._prefix = prefix
         self._server = None
@@ -629,13 +677,27 @@ class DebugServer:
             def log_message(self, fmt, *args):  # quiet by default
                 logger.debug('debug endpoint: ' + fmt, *args)
 
-            def _reply(self, status: int, content_type: str, body: str):
+            def _reply(self, status: int, content_type: str, body: str,
+                       extra_headers: Optional[Dict[str, str]] = None):
                 payload = body.encode('utf-8')
                 self.send_response(status)
                 self.send_header('Content-Type', content_type)
                 self.send_header('Content-Length', str(len(payload)))
+                for name, value in (extra_headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(payload)
+
+            def _pod_headers(self) -> Dict[str, str]:
+                """The pod-plane response headers: echo the caller's trace
+                id and stamp this host's monotonic clock at reply time —
+                the aggregator's clock-offset anchor."""
+                from petastorm_tpu.podobs import CLOCK_HEADER, TRACE_HEADER
+                headers = {CLOCK_HEADER: repr(time.perf_counter())}
+                trace_id = self.headers.get(TRACE_HEADER)
+                if trace_id:
+                    headers[TRACE_HEADER] = trace_id
+                return headers
 
             def do_GET(self):  # noqa: N802 - http.server API
                 try:
@@ -710,6 +772,29 @@ class DebugServer:
                             self._reply(200, 'application/json',
                                         json.dumps(outer._autotune_fn(),
                                                    default=str))
+                    elif route == '/observe/snapshot':
+                        if outer._observe_fn is None:
+                            self._reply(404, 'text/plain',
+                                        'the pod observability plane is off '
+                                        'or unwired for this reader '
+                                        '(PETASTORM_TPU_PODOBS=0)\n')
+                        else:
+                            self._reply(200, 'application/json',
+                                        json.dumps(outer._observe_fn(),
+                                                   default=str),
+                                        extra_headers=self._pod_headers())
+                    elif route == '/podmetrics':
+                        if outer._podmetrics_fn is None:
+                            self._reply(404, 'text/plain',
+                                        'this host is not a pod aggregator '
+                                        '(set PETASTORM_TPU_PODOBS_PEERS to '
+                                        'a host:port list, or run '
+                                        'petastorm-tpu-podstat)\n')
+                        else:
+                            self._reply(200, 'application/json',
+                                        json.dumps(outer._podmetrics_fn(),
+                                                   default=str),
+                                        extra_headers=self._pod_headers())
                     elif route == '/stacks':
                         stacks = thread_stacks()
                         body = '\n'.join('== {} ==\n{}'.format(name, stack)
@@ -720,7 +805,8 @@ class DebugServer:
                         self._reply(404, 'text/plain',
                                     'unknown route {}; try /healthz /metrics '
                                     '/diagnostics /coverage /profile /slo '
-                                    '/autotune /stacks\n'.format(route))
+                                    '/autotune /observe/snapshot /podmetrics '
+                                    '/stacks\n'.format(route))
                 except Exception as e:  # report, never kill the serve loop
                     logger.exception('debug endpoint request failed')
                     try:

@@ -60,9 +60,13 @@ LATENCY_ENV_VAR = 'PETASTORM_TPU_LATENCY'
 #: delivery path; ``infeed_wait``/``train_step`` from the JAX loader's
 #: iteration loop; ``device_stage`` from the staging helpers; ``e2e_batch``
 #: is ventilate-timestamp → batch delivery, correlated through the lineage
-#: seq (see ``docs/latency.md``).
+#: seq (see ``docs/latency.md``); ``io_range`` is one planned object-store
+#: range fetch (``ParallelRangeReader.fetch_range``, hedge+retry included);
+#: ``peer_fetch`` is one shared-cache peer HTTP fetch attempt (see
+#: ``docs/pod_observability.md``).
 STAGES = ('io', 'decode', 'queue_wait', 'deserialize', 'infeed_wait',
-          'train_step', 'device_stage', 'e2e_batch')
+          'train_step', 'device_stage', 'e2e_batch', 'io_range',
+          'peer_fetch')
 
 #: ``ReaderStats`` time-stage names → latency stage fed from the same
 #: ``record_time`` call (worker-side observations).
@@ -372,6 +376,26 @@ class LatencyDeltas:
         mapped = TIME_STAGE_TO_LATENCY.get(stage)
         if mapped is not None:
             self.record(mapped, seconds)
+
+    def absorb(self, deltas: Optional[Dict[str, dict]]) -> None:
+        """Fold another drained ``{stage: delta}`` mapping into this
+        accumulator (pure bucket-count addition). This is how a worker folds
+        deltas drained from a component it owns (``ParallelRangeReader``,
+        the shared cache) into its own per-message shipment — same
+        single-writer discipline as :meth:`record`."""
+        if not deltas:
+            return
+        for stage, delta in deltas.items():
+            entry = self._stages.get(stage)
+            if entry is None:
+                entry = self._stages[stage] = {'buckets': {}, 'sum': 0.0,
+                                               'count': 0}
+            buckets = entry['buckets']
+            for index, n in (delta.get('buckets') or {}).items():
+                index = min(int(index), NUM_BUCKETS)
+                buckets[index] = buckets.get(index, 0) + int(n)
+            entry['sum'] += float(delta.get('sum', 0.0))
+            entry['count'] += int(delta.get('count', 0))
 
     def drain(self) -> Optional[Dict[str, dict]]:
         """Return and reset the accumulated deltas (``None`` when empty), in

@@ -34,8 +34,11 @@ from __future__ import annotations
 import io
 import struct
 import threading
+import time
 from bisect import bisect_right, insort
 from typing import Callable, Dict, List, Optional, Tuple
+
+from petastorm_tpu.latency import bucket_index
 
 #: Merge two planned ranges when the gap between them is at most this many
 #: bytes: one round trip costs more than re-downloading a small gap.
@@ -289,13 +292,27 @@ class ParallelRangeReader:
         per-request discipline that makes hedging cheap (a straggler range
         is duplicated alone, not the whole row group).
     :param max_in_flight: concurrent range fetches per row-group read.
+    :param observe_spans: record one ``range_fetch`` span tuple per
+        :meth:`fetch_range` (retry count annotated; the hedge layer's
+        per-attempt spans come from ``ResilientIO.take_spans``). Off by
+        default — the pod-observability plane opts in at construction
+        (``docs/pod_observability.md``).
+    :param observe_latency: feed each :meth:`fetch_range` duration into an
+        internal ``io_range`` latency delta, drained by
+        :meth:`take_latency` (the ``LatencyDeltas.drain`` shape).
     """
+
+    #: Bound on undrained ``range_fetch`` spans (a construction that never
+    #: drains must not grow without limit).
+    MAX_PENDING_SPANS = 2048
 
     def __init__(self, filesystem, resilience=None,
                  gap_bytes: int = DEFAULT_GAP_BYTES,
                  max_range_bytes: int = DEFAULT_MAX_RANGE_BYTES,
                  max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
-                 footer_bytes: int = DEFAULT_FOOTER_BYTES):
+                 footer_bytes: int = DEFAULT_FOOTER_BYTES,
+                 observe_spans: bool = False,
+                 observe_latency: bool = False):
         if max_in_flight < 1:
             raise ValueError('max_in_flight must be >= 1, got '
                              '{}'.format(max_in_flight))
@@ -309,6 +326,15 @@ class ParallelRangeReader:
         # path -> (file size, FileMetaData, footer tail (offset, bytes))
         self._footers: Dict[str, Tuple[int, object, Tuple[int, bytes]]] = {}
         self._events: Dict[str, int] = {}
+        self._observe_spans = bool(observe_spans)
+        self._observe_latency = bool(observe_latency)
+        # (name, cat, start_s, dur_s, args) tuples; accumulated under the
+        # mutex because fetch_range runs on the worker thread, the
+        # readahead thread AND the per-call pump threads
+        self._spans: list = []
+        # {'io_range': {'buckets': {index: n}, 'sum': s, 'count': n}} — the
+        # LatencyDeltas entry shape, mergeable by bucket addition
+        self._latency: Dict[str, dict] = {}
 
     # -- events ----------------------------------------------------------------
 
@@ -322,6 +348,53 @@ class ParallelRangeReader:
         with self._mutex:
             events, self._events = self._events, {}
         return events
+
+    def _observe_fetch(self, offset: int, length: int, start_s: float,
+                       retries: int, error: Optional[str]) -> None:
+        """Record one finished :meth:`fetch_range` into the span/latency
+        accumulators (mutex-guarded: callers include pump threads)."""
+        dur_s = time.perf_counter() - start_s
+        span = None
+        if self._observe_spans:
+            args: dict = {'offset': offset, 'length': length}
+            if retries:
+                args['retries'] = retries
+            if error is not None:
+                args['error'] = error
+            span = ('range_fetch', 'io', start_s, dur_s, args)
+        with self._mutex:
+            if span is not None:
+                self._spans.append(span)
+                if len(self._spans) > self.MAX_PENDING_SPANS:
+                    del self._spans[:len(self._spans)
+                                    - self.MAX_PENDING_SPANS]
+            if self._observe_latency:
+                entry = self._latency.get('io_range')
+                if entry is None:
+                    entry = self._latency['io_range'] = {
+                        'buckets': {}, 'sum': 0.0, 'count': 0}
+                index = bucket_index(dur_s)
+                entry['buckets'][index] = entry['buckets'].get(index, 0) + 1
+                entry['sum'] += dur_s
+                entry['count'] += 1
+
+    def take_spans(self) -> list:
+        """Drain accumulated ``range_fetch`` span tuples (worker thread
+        only; empty unless ``observe_spans=True``)."""
+        with self._mutex:
+            spans, self._spans = self._spans, []
+        return spans
+
+    def take_latency(self) -> Optional[Dict[str, dict]]:
+        """Drain the accumulated ``io_range`` latency deltas (worker thread
+        only; ``None`` unless ``observe_latency=True`` and data exists).
+        Shape matches ``LatencyDeltas.drain`` — absorb with
+        ``LatencyDeltas.absorb`` or ``PipelineLatency.merge_deltas``."""
+        with self._mutex:
+            if not self._latency:
+                return None
+            latency, self._latency = self._latency, {}
+        return latency
 
     # -- range fetch -----------------------------------------------------------
 
@@ -341,16 +414,39 @@ class ParallelRangeReader:
         return b''.join(parts)
 
     def fetch_range(self, path: str, offset: int, length: int) -> bytes:
-        """One resilient ranged read: retry + hedge apply to THIS range."""
+        """One resilient ranged read: retry + hedge apply to THIS range.
+        With the observe flags set, the whole resilient call (hedges and
+        retries included — the latency the pipeline actually saw) lands as
+        one ``range_fetch`` span / ``io_range`` latency observation."""
         def fetch():
             return self._fetch_once(path, offset, length)
         self._count('io_range_requests')
         self._count('io_range_bytes', length)
-        if self._resilience is not None and self._resilience.enabled:
-            return self._resilience.read(
-                fetch, description='range_read({}@{}+{})'.format(
-                    path, offset, length))
-        return fetch()
+        observing = self._observe_spans or self._observe_latency
+        if not observing:
+            if self._resilience is not None and self._resilience.enabled:
+                return self._resilience.read(
+                    fetch, description='range_read({}@{}+{})'.format(
+                        path, offset, length))
+            return fetch()
+        retries = [0]
+        start_s = time.perf_counter()
+        try:
+            if self._resilience is not None and self._resilience.enabled:
+                def on_retry(exc, attempt):
+                    retries[0] += 1
+                result = self._resilience.read(
+                    fetch, on_retry=on_retry,
+                    description='range_read({}@{}+{})'.format(
+                        path, offset, length))
+            else:
+                result = fetch()
+        except Exception as e:
+            self._observe_fetch(offset, length, start_s, retries[0],
+                                type(e).__name__)
+            raise
+        self._observe_fetch(offset, length, start_s, retries[0], None)
+        return result
 
     # -- footer / metadata -----------------------------------------------------
 

@@ -823,7 +823,30 @@ class Reader:
             if stall_timeout:
                 self._watchdog.start()
         if resolved_debug_port is not None:
+            from petastorm_tpu.podobs import podobs_enabled
             from petastorm_tpu.profiler import profiler_enabled
+            observe_fn = None
+            podmetrics_fn = None
+            if podobs_enabled():
+                # pod observability plane (docs/pod_observability.md): this
+                # host's one-JSON snapshot on /observe/snapshot, and — when
+                # the env names a pod peer list — the aggregated /podmetrics
+                from petastorm_tpu.podobs import (PodObserver,
+                                                  make_observe_fn,
+                                                  pod_peers_from_env)
+                observe_fn = make_observe_fn(
+                    snapshot_fn=self._stats_snapshot,
+                    health_fn=self._watchdog.evaluate,
+                    slo_fn=(self._slo.evaluate if self._slo is not None
+                            else None),
+                    coverage_fn=(self.lineage.coverage_report
+                                 if self.lineage.enabled else None),
+                    cache_counters_fn=getattr(cache, 'host_counters', None),
+                    span_tail_fn=(tracer.tail if tracer is not None
+                                  else None))
+                pod_peers = pod_peers_from_env()
+                if pod_peers:
+                    podmetrics_fn = PodObserver(pod_peers).report
             self._debug_server = DebugServer(
                 self._watchdog.evaluate, self._stats_snapshot,
                 self.health.heartbeats, port=resolved_debug_port,
@@ -834,7 +857,9 @@ class Reader:
                 slo_fn=(self._slo.evaluate if self._slo is not None
                         else None),
                 autotune_fn=(self._controller.report
-                             if self._controller is not None else None))
+                             if self._controller is not None else None),
+                observe_fn=observe_fn,
+                podmetrics_fn=podmetrics_fn)
             try:
                 self._debug_server.start()
             except (OSError, OverflowError) as e:   # taken / out-of-range port

@@ -146,9 +146,17 @@ class ParquetPieceWorker(WorkerBase):
         # Spawned process workers inherit the env var and wrap themselves.
         self._filesystem = faultfs.maybe_wrap(args['filesystem_factory']())
         # -- resilient IO (retry + hedge; see petastorm_tpu/resilience.py) -----
+        # pod observability (docs/pod_observability.md): read-plane spans
+        # ride the tracing plane, io_range/peer_fetch latency rides the
+        # latency plane — each gated on its host plane AND the podobs switch
+        from petastorm_tpu.podobs import podobs_enabled
+        observe_pod = podobs_enabled()
+        self._observe_spans = observe_pod and self.tracing_enabled
+        self._observe_latency = observe_pod and self.latency is not None
         retry_options = resolve_retry(args.get('retry', True))
         hedge_options = resolve_hedge(args.get('hedge', False))
-        self._resilience = (ResilientIO(retry_options, hedge_options)
+        self._resilience = (ResilientIO(retry_options, hedge_options,
+                                        observe_spans=self._observe_spans)
                             if retry_options or hedge_options else None)
         self._dataset_path = args['dataset_path']
         self._schema = args['schema']                  # output view
@@ -191,7 +199,9 @@ class ParquetPieceWorker(WorkerBase):
         # one range reader per worker, shared with the readahead thread
         # (thread-safe: every read builds its own buffer and store handles)
         self._range_reader = (ParallelRangeReader(
-            self._filesystem, resilience=self._resilience)
+            self._filesystem, resilience=self._resilience,
+            observe_spans=self._observe_spans,
+            observe_latency=self._observe_latency)
             if mode == 'ranged' else None)
         self._open_files = FileHandleCache(
             self._open_parquet, fs_key=lambda: id(self._filesystem))
@@ -470,11 +480,18 @@ class ParquetPieceWorker(WorkerBase):
             for name, n in self._range_reader.take_events().items():
                 if n:
                     self.record_count(name, n)
+            for span in self._range_reader.take_spans():
+                self.record_span(*span)
+            deltas = self._range_reader.take_latency()
+            if deltas and self.latency is not None:
+                self.latency.absorb(deltas)
         if self._resilience is None:
             return
         for name, n in self._resilience.take_events().items():
             if n:
                 self.record_count(name, n)
+        for span in self._resilience.take_spans():
+            self.record_span(*span)
 
     def _decode_table(self, table, names,
                       error_sink: Optional[DecodeErrorSink] = None) -> Dict:
@@ -709,6 +726,17 @@ class ParquetPieceWorker(WorkerBase):
         for name, n in take_events().items():
             if n:
                 self.record_count(name, n)
+        # pod-tier observability (docs/pod_observability.md): peer_fetch
+        # spans ride the tracing plane, peer_fetch latency the latency plane
+        take_spans = getattr(cache, 'take_spans', None)
+        if take_spans is not None:
+            for span in take_spans():
+                self.record_span(*span)
+        take_latency = getattr(cache, 'take_latency', None)
+        if take_latency is not None and self.latency is not None:
+            deltas = take_latency()
+            if deltas:
+                self.latency.absorb(deltas)
         self.record_gauge('shared_cache_bytes', cache.occupancy_bytes())
         return value
 

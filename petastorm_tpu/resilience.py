@@ -329,7 +329,8 @@ class HedgedRead:
     """
 
     def __init__(self, options: dict,
-                 on_event: Optional[Callable[[str, int], None]] = None):
+                 on_event: Optional[Callable[[str, int], None]] = None,
+                 on_attempt: Optional[Callable[[dict], None]] = None):
         self._fixed_threshold = options.get('threshold_s')
         self._threshold = AdaptiveThreshold(
             scale=options.get('threshold_scale', 2.0),
@@ -337,6 +338,14 @@ class HedgedRead:
             max_s=options.get('max_threshold_s', 5.0),
             warmup=options.get('warmup_samples', 8))
         self._on_event = on_event
+        #: Per-attempt observability hook: called once per finished attempt
+        #: (winner AND abandoned loser) with ``{'tag', 'start_s', 'dur_s',
+        #: 'won', 'cancelled_by_hedge', 'description'}`` — the loser of a
+        #: decided race is the attempt hedging cancelled, which counters
+        #: alone cannot show (satellite: BENCH_r18's "0 hedges fired" claim
+        #: must be visible in a trace). May be called from a race thread;
+        #: the callback must be thread-safe.
+        self._on_attempt = on_attempt
         # live race threads (winners AND abandoned losers): drained at
         # shutdown so no thread is still inside a C read when the
         # interpreter finalizes
@@ -362,6 +371,22 @@ class HedgedRead:
         if self._on_event is not None:
             self._on_event(name, n)
 
+    def _report_attempt(self, tag: str, start_s: float, won: bool,
+                        description: str) -> None:
+        """Fire :attr:`_on_attempt` for one finished attempt. Losing an
+        already-decided race is the cancelled-by-hedge annotation: the only
+        way an attempt loses is that its twin won first."""
+        if self._on_attempt is None:
+            return
+        try:
+            self._on_attempt({'tag': tag, 'start_s': start_s,
+                              'dur_s': time.perf_counter() - start_s,
+                              'won': bool(won),
+                              'cancelled_by_hedge': not won,
+                              'description': description})
+        except Exception:  # observability must never fail the read
+            logger.debug('hedge on_attempt callback failed', exc_info=True)
+
     def call(self, primary_fn, hedge_fn=None, description: str = 'read'):
         """Run ``primary_fn()``; if it is still running after the live
         threshold, also run ``hedge_fn()`` (defaults to ``primary_fn``) on a
@@ -376,21 +401,24 @@ class HedgedRead:
             start = time.perf_counter()
             value = primary_fn()
             self._threshold.observe(time.perf_counter() - start)
+            self._report_attempt('primary', start, True, description)
             return value
         race = _HedgeRace()
         start = time.perf_counter()
 
         def run(tag, fn):
+            attempt_start = time.perf_counter()
             try:
                 try:
                     value = fn()
                 except BaseException as e:  # noqa: BLE001 - winner re-raises
-                    race.finish(tag, error=e)
+                    won = race.finish(tag, error=e)
                 else:
                     won = race.finish(tag, value=value)
                     if tag == 'hedge':
                         self._event('io_hedge_wins' if won
                                     else 'io_hedge_losses')
+                self._report_attempt(tag, attempt_start, won, description)
             finally:
                 with self._live_lock:
                     self._live.discard(threading.current_thread())
@@ -431,27 +459,61 @@ class ResilientIO:
     discipline as the shared cache's event drain — ``record_count`` is not
     safe from the background thread)."""
 
+    #: Bound on undrained attempt spans: a direct construction that never
+    #: drains (benchmarks, tests) must not grow without limit.
+    MAX_PENDING_SPANS = 2048
+
     def __init__(self, retry_options: Optional[dict] = None,
                  hedge_options: Optional[dict] = None,
                  classify: Callable[[BaseException], str] = classify_read_error,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 observe_spans: bool = False):
         self.retry = (RetryPolicy(classify=classify, seed=seed,
                                   **retry_options)
                       if retry_options else None)
-        self.hedge = (HedgedRead(hedge_options, on_event=self._count)
+        self._observe_spans = bool(observe_spans)
+        self.hedge = (HedgedRead(hedge_options, on_event=self._count,
+                                 on_attempt=(self._record_attempt
+                                             if self._observe_spans
+                                             else None))
                       if hedge_options else None)
         self._lock = threading.Lock()
         self._events: Dict[str, int] = {}
+        # (name, cat, start_s, dur_s, args) tuples — the WorkerBase
+        # record_span shape, drained by the worker thread
+        self._spans: list = []
 
     def _count(self, name: str, n: int = 1) -> None:
         with self._lock:
             self._events[name] = self._events.get(name, 0) + n
+
+    def _record_attempt(self, info: dict) -> None:
+        """Accumulate one hedge-race attempt as a span tuple (called from
+        race threads — lock-protected, bounded)."""
+        args = {'attempt': info.get('tag'),
+                'description': info.get('description'),
+                'won': bool(info.get('won'))}
+        if info.get('cancelled_by_hedge'):
+            args['cancelled_by_hedge'] = True
+        span = ('io_attempt', 'io', info.get('start_s'),
+                info.get('dur_s'), args)
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > self.MAX_PENDING_SPANS:
+                del self._spans[:len(self._spans) - self.MAX_PENDING_SPANS]
 
     def take_events(self) -> Dict[str, int]:
         """Drain the accumulated counter deltas (worker thread only)."""
         with self._lock:
             events, self._events = self._events, {}
         return events
+
+    def take_spans(self) -> list:
+        """Drain the accumulated per-attempt span tuples (worker thread
+        only; empty unless constructed with ``observe_spans=True``)."""
+        with self._lock:
+            spans, self._spans = self._spans, []
+        return spans
 
     def drain(self, timeout_s: float = 5.0) -> None:
         """Join outstanding hedge race threads (worker shutdown): an

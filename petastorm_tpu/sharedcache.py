@@ -555,6 +555,11 @@ class SharedRowGroupCache(CacheBase):
     copy re-attaches to the same tiers with fresh local state.
     """
 
+    #: Bound on pending un-drained ``peer_fetch`` spans (matches the
+    #: read-plane bound in :mod:`petastorm_tpu.objectstore`): if nobody
+    #: drains, capture saturates instead of growing without bound.
+    MAX_PENDING_SPANS = 2048
+
     def __init__(self, path: str, size_limit_bytes: int,
                  mem_size_limit_bytes: Optional[int] = None,
                  mem_dir: Optional[str] = None,
@@ -609,6 +614,15 @@ class SharedRowGroupCache(CacheBase):
                         'lock_steals': 0, 'put_failures': 0,
                         'peer_hits': 0, 'peer_misses': 0, 'peer_errors': 0,
                         'peer_bytes': 0}
+        # pod-observability capture (docs/pod_observability.md): per-attempt
+        # peer_fetch spans + latency deltas accumulate here (gated on
+        # PETASTORM_TPU_PODOBS) until the owning worker drains them via
+        # take_spans()/take_latency(); peer requests carry the trace id
+        from petastorm_tpu.podobs import new_trace_id, podobs_enabled
+        self._observe_pod = podobs_enabled()
+        self._trace_id = new_trace_id() if self._observe_pod else ''
+        self._pod_spans: list = []
+        self._pod_latency: Dict[str, dict] = {}
         #: the pod-tier hedge plane (docs/object_store.md): a fixed-threshold
         #: HedgedRead racing "fetch from a peer's cache" against "decode
         #: locally" — the same primitive the range reader uses per range
@@ -932,6 +946,45 @@ class SharedRowGroupCache(CacheBase):
         short = name.replace('io_', 'peer_')
         self._bump(short, 'shared_' + short, n)
 
+    def _observe_peer_fetch(self, peer: str, start_s: float, outcome: str,
+                            nbytes: int) -> None:
+        """Record one pod-tier peer attempt as a ``peer_fetch`` span plus a
+        ``peer_fetch`` latency observation (docs/pod_observability.md). The
+        owning worker drains both via :meth:`take_spans` /
+        :meth:`take_latency`. No-op unless the pod observability plane is
+        on."""
+        if not self._observe_pod:
+            return
+        dur_s = time.perf_counter() - start_s
+        span = ('peer_fetch', 'io', start_s, dur_s,
+                {'peer': peer, 'outcome': outcome, 'bytes': nbytes})
+        from petastorm_tpu.latency import bucket_index
+        index = bucket_index(dur_s)
+        with self._lock:
+            if len(self._pod_spans) < self.MAX_PENDING_SPANS:
+                self._pod_spans.append(span)
+            entry = self._pod_latency.setdefault(
+                'peer_fetch', {'buckets': {}, 'sum': 0.0, 'count': 0})
+            entry['buckets'][index] = entry['buckets'].get(index, 0) + 1
+            entry['sum'] += dur_s
+            entry['count'] += 1
+
+    def take_spans(self) -> list:
+        """Drain pending ``peer_fetch`` spans (``(name, cat, start_s,
+        dur_s, args)`` tuples on the monotonic clock); empty unless the pod
+        observability plane recorded any."""
+        with self._lock:
+            spans, self._pod_spans = self._pod_spans, []
+        return spans
+
+    def take_latency(self) -> Optional[Dict[str, dict]]:
+        """Drain pending ``peer_fetch`` latency deltas in the
+        ``LatencyDeltas.drain()`` shape, or ``None`` when nothing was
+        recorded."""
+        with self._lock:
+            latency, self._pod_latency = self._pod_latency, {}
+        return latency or None
+
     def segment_bytes(self, digest: str) -> Optional[bytes]:
         """Raw bytes of a resident segment, tier 0 first (the peer-protocol
         server side; ``None`` = miss). Lock-free like every read: publishers
@@ -957,9 +1010,16 @@ class SharedRowGroupCache(CacheBase):
             url = 'http://{}/peercache/{}'.format(peer, digest)
             tmp = None
             nbytes = 0
+            attempt_start = time.perf_counter()
+            request = urllib.request.Request(url)
+            if self._observe_pod:
+                # trace propagation (docs/pod_observability.md): the serving
+                # peer echoes this id, stitching both hosts into one track
+                from petastorm_tpu.podobs import TRACE_HEADER
+                request.add_header(TRACE_HEADER, self._trace_id)
             try:
                 with urllib.request.urlopen(
-                        url, timeout=self._peer_timeout_s) as resp:
+                        request, timeout=self._peer_timeout_s) as resp:
                     fd, tmp = tempfile.mkstemp(dir=self._path,
                                                suffix='.peer')
                     with os.fdopen(fd, 'wb') as out:
@@ -979,11 +1039,18 @@ class SharedRowGroupCache(CacheBase):
             except urllib.error.HTTPError as e:
                 if e.code != 404:    # 404 is an honest peer miss
                     self._bump('peer_errors', 'shared_peer_errors')
+                    self._observe_peer_fetch(peer, attempt_start, 'error',
+                                             nbytes)
+                else:
+                    self._observe_peer_fetch(peer, attempt_start, 'miss',
+                                             nbytes)
                 continue
             except (OSError, CorruptSegmentError, ValueError) as e:
                 logger.warning('peer-cache fetch %s failed (degrading to '
                                'next peer / local fill): %s', url, e)
                 self._bump('peer_errors', 'shared_peer_errors')
+                self._observe_peer_fetch(peer, attempt_start, 'error',
+                                         nbytes)
                 continue
             finally:
                 if tmp is not None:
@@ -996,7 +1063,9 @@ class SharedRowGroupCache(CacheBase):
                 self._bump('peer_hits', 'shared_peer_hits')
                 with self._lock:
                     self._totals['peer_bytes'] += nbytes
+                self._observe_peer_fetch(peer, attempt_start, 'hit', nbytes)
                 return attached
+            self._observe_peer_fetch(peer, attempt_start, 'miss', nbytes)
         self._bump('peer_misses', 'shared_peer_misses')
         return None
 
@@ -1162,6 +1231,15 @@ class SharedRowGroupCache(CacheBase):
         with self._lock:
             return dict(self._totals)
 
+    def host_counters(self) -> Dict[str, int]:
+        """This HOST's totals over every process attached to this cache
+        root (:meth:`global_counters` of our own path, flushing first so
+        this instance's unflushed tail is included) — the per-host ``cache``
+        section of the pod observability snapshot, whose pod-wide sum of
+        ``fills`` the decode-once certificate checks."""
+        self._flush_counters()
+        return self.global_counters(self._path)
+
     def _flush_counters(self) -> None:
         with self._lock:
             if self._closed:
@@ -1261,6 +1339,23 @@ class PeerCacheServer:
         #: The bound port (differs from the requested one when it was 0).
         self.port: Optional[int] = None
 
+    @staticmethod
+    def _pod_headers(handler) -> Dict[str, str]:
+        """Trace propagation on the pod cache protocol
+        (docs/pod_observability.md): echo the caller's ``X-Petastorm-Trace``
+        id and stamp this host's monotonic clock so the fetching side can
+        estimate the pod clock offset. Empty (no extra headers) when the pod
+        observability plane is off."""
+        from petastorm_tpu.podobs import (CLOCK_HEADER, TRACE_HEADER,
+                                          podobs_enabled)
+        if not podobs_enabled():
+            return {}
+        headers = {CLOCK_HEADER: repr(time.perf_counter())}
+        trace = handler.headers.get(TRACE_HEADER)
+        if trace:
+            headers[TRACE_HEADER] = trace
+        return headers
+
     def start(self) -> 'PeerCacheServer':
         if self._server is not None:
             return self
@@ -1276,6 +1371,8 @@ class PeerCacheServer:
                 self.send_response(status)
                 self.send_header('Content-Type', content_type)
                 self.send_header('Content-Length', str(len(body)))
+                for name, value in outer._pod_headers(self).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 

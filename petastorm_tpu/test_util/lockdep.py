@@ -65,6 +65,7 @@ DEFAULT_TARGET_MODULES = (
     'petastorm_tpu.readers.piece_worker',
     'petastorm_tpu.ops.decode',
     'petastorm_tpu.objectstore',
+    'petastorm_tpu.podobs',
 )
 
 

@@ -221,6 +221,50 @@ class Tracer:
                 for name, cat, start_s, dur_s, pid, tid, args in spans]
 
 
+def stitch_pod_trace(tracks: List[dict], path: str) -> str:
+    """Stitch per-host span tails into ONE clock-aligned chrome trace.
+
+    ``tracks`` is the ``trace_tracks`` list of a
+    :class:`~petastorm_tpu.podobs.PodObserver` report: one entry per host
+    with ``host``, ``pid``, ``clock_offset_s`` (that host's monotonic clock
+    minus the aggregator's, estimated from the poll round trip) and
+    ``spans`` (tail dicts, ``ts``/``dur`` in µs on the HOST's clock). Every
+    span's ``ts`` is shifted by ``-clock_offset_s`` onto the aggregator's
+    timeline, and each distinct ``(host, pid)`` pair is remapped to a
+    unique synthetic pid with a ``process_name`` metadata row naming the
+    host — two hosts' identical OS pids must not collapse into one
+    Perfetto track. The write is atomic (a crash never leaves a truncated
+    JSON). Returns ``path``."""
+    from petastorm_tpu.utils import atomic_write
+    events: List[dict] = []
+    pid_map: Dict[Tuple[str, object], int] = {}
+    for track in tracks:
+        host = str(track.get('host'))
+        offset_s = track.get('clock_offset_s') or 0.0
+        offset_us = float(offset_s) * 1e6
+        for span in track.get('spans') or []:
+            key = (host, span.get('pid'))
+            pid = pid_map.get(key)
+            if pid is None:
+                pid = pid_map[key] = len(pid_map) + 1
+                events.append({
+                    'name': 'process_name', 'ph': 'M', 'pid': pid, 'tid': 0,
+                    'args': {'name': 'petastorm_tpu {} (pid {})'.format(
+                        host, span.get('pid'))}})
+            event = {'name': span.get('name'),
+                     'cat': span.get('cat') or 'pipeline', 'ph': 'X',
+                     'ts': float(span.get('ts', 0.0)) - offset_us,
+                     'dur': max(0.0, float(span.get('dur', 0.0))),
+                     'pid': pid, 'tid': span.get('tid', 0)}
+            if span.get('args'):
+                event['args'] = span['args']
+            events.append(event)
+    events.sort(key=lambda e: (e['ph'] != 'M', e.get('ts', 0.0)))
+    atomic_write(path, lambda f: json.dump(
+        {'traceEvents': events, 'displayTimeUnit': 'ms'}, f))
+    return path
+
+
 def _prometheus_value(value: float) -> str:
     """One sample value per the text-exposition format: finite floats print
     normally, non-finite ones as the spec's ``NaN``/``+Inf``/``-Inf``

@@ -97,7 +97,8 @@ GAUGES = ('queue_depth', 'shuffle_buffer_depth', 'readahead_depth',
 #: (``docs/latency.md``); 0.0 when the latency plane is disabled or has no
 #: observations yet.
 DERIVED = ('io_overlap_fraction', 'window_s', 'items_per_s', 'mb_per_s',
-           'queue_wait_p50_s', 'queue_wait_p99_s', 'e2e_latency_p99_s')
+           'queue_wait_p50_s', 'queue_wait_p99_s', 'e2e_latency_p99_s',
+           'io_range_p99_s', 'peer_fetch_p99_s')
 
 #: Snapshot key carrying the raw per-stage histogram states (bucket-count
 #: pairs + sum/count) when the latency plane is on — what ``/metrics``
@@ -238,6 +239,12 @@ class ReaderStats:
             out['queue_wait_p50_s'] = queue_wait.quantile(0.5) or 0.0
             out['queue_wait_p99_s'] = queue_wait.quantile(0.99) or 0.0
             out['e2e_latency_p99_s'] = e2e.quantile(0.99) or 0.0
+            # read-plane tails (docs/pod_observability.md): lets the health
+            # verdict NAME a slow object store / slow peer cache
+            out['io_range_p99_s'] = (
+                latency.histograms['io_range'].quantile(0.99) or 0.0)
+            out['peer_fetch_p99_s'] = (
+                latency.histograms['peer_fetch'].quantile(0.99) or 0.0)
             state = latency.export_state()
             if state:   # stages with observations only; never an empty key
                 out[LATENCY_HISTOGRAMS_KEY] = state
@@ -245,6 +252,8 @@ class ReaderStats:
             out['queue_wait_p50_s'] = 0.0
             out['queue_wait_p99_s'] = 0.0
             out['e2e_latency_p99_s'] = 0.0
+            out['io_range_p99_s'] = 0.0
+            out['peer_fetch_p99_s'] = 0.0
         return out
 
 

@@ -1,0 +1,369 @@
+"""Pod observability plane: merge semantics, certificates, HTTP surfaces.
+
+The merge tests drive :func:`podobs.merge_histogram_states` and
+``PodObserver.merge`` with simulated host snapshots (pure functions, no
+HTTP); the surface tests spin real ``DebugServer`` / peer-cache endpoints
+on loopback so trace-header propagation and the named ``partial_pod``
+degradation are exercised over the wire the pod actually uses.
+"""
+import json
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import podobs
+from petastorm_tpu.health import (DEGRADED, HEALTHY, STALLED, STARVING,
+                                  DebugServer)
+from petastorm_tpu.latency import LatencyHistogram
+from petastorm_tpu.podobs import (CLOCK_HEADER, PARTIAL_POD, TRACE_HEADER,
+                                  VERDICT_ORDER, PodCertificateError,
+                                  PodObserver, check_pod_certificate,
+                                  make_observe_fn, merge_counters,
+                                  merge_health, merge_histogram_states,
+                                  new_trace_id, parse_peers, podobs_enabled,
+                                  state_percentiles)
+from petastorm_tpu.sharedcache import SharedRowGroupCache
+from petastorm_tpu.workers.stats import LATENCY_HISTOGRAMS_KEY
+
+
+def _http_get(port, route, headers=None):
+    from http.client import HTTPConnection
+    conn = HTTPConnection('127.0.0.1', port, timeout=10)
+    try:
+        conn.request('GET', route, headers=headers or {})
+        response = conn.getresponse()
+        return response.status, response.read(), dict(response.getheaders())
+    finally:
+        conn.close()
+
+
+def _sample_latencies(n=400, seed=7):
+    rng = random.Random(seed)
+    # spread across several decades so every percentile lands in a
+    # different bucket — a merge bug cannot hide in a single-bucket blob
+    return [rng.lognormvariate(-6.0, 2.0) for _ in range(n)]
+
+
+class TestEnabling:
+    def test_default_on_and_kill_switch(self, monkeypatch):
+        monkeypatch.delenv(podobs.PODOBS_ENV_VAR, raising=False)
+        assert podobs_enabled()
+        for off in ('0', 'false', 'off'):
+            monkeypatch.setenv(podobs.PODOBS_ENV_VAR, off)
+            assert not podobs_enabled()
+
+    def test_parse_peers_rejects_portless_entries(self):
+        assert parse_peers('a:1, b:2,,') == ('a:1', 'b:2')
+        with pytest.raises(ValueError):
+            parse_peers('just-a-host')
+        with pytest.raises(ValueError):
+            PodObserver([])
+
+    def test_verdict_order_matches_health_constants(self):
+        # worst-of merge ranks by this tuple; it must stay in lockstep
+        # with the health module's vocabulary
+        assert VERDICT_ORDER == (HEALTHY, DEGRADED, STARVING, STALLED)
+
+
+class TestHistogramMerge:
+    def test_three_host_merge_bit_identical_to_direct(self):
+        direct = LatencyHistogram()
+        hosts = [LatencyHistogram() for _ in range(3)]
+        for i, seconds in enumerate(_sample_latencies()):
+            direct.record(seconds)
+            hosts[i % 3].record(seconds)
+        states = [{'io_range': h.state()} for h in hosts]
+        merged = merge_histogram_states(states)['io_range']
+        assert merged['buckets'] == direct.state()['buckets']
+        assert merged['count'] == direct.state()['count']
+        assert merged['sum'] == pytest.approx(direct.state()['sum'])
+        # percentile estimates are a pure function of the (identical)
+        # bucket counts: bit-identical, error bound intact
+        pod = state_percentiles(merged)
+        local = direct.percentiles()
+        for name in ('p50', 'p90', 'p99', 'p999'):
+            assert pod[name] == local[name]
+
+    def test_merge_is_associative(self):
+        hosts = [LatencyHistogram() for _ in range(3)]
+        for i, seconds in enumerate(_sample_latencies(seed=11)):
+            hosts[i % 3].record(seconds)
+        states = [{'io_range': h.state()} for h in hosts]
+        left = merge_histogram_states(
+            [merge_histogram_states(states[:2]), states[2]])
+        flat = merge_histogram_states(states)
+        assert left['io_range']['buckets'] == flat['io_range']['buckets']
+        assert left['io_range']['count'] == flat['io_range']['count']
+
+    def test_empty_and_missing_states_merge_clean(self):
+        one = LatencyHistogram()
+        one.record(0.01)
+        merged = merge_histogram_states(
+            [None, {}, {'decode': one.state()}, {'decode': {'buckets': [],
+                                                            'sum': 0.0,
+                                                            'count': 0}}])
+        assert merged['decode']['count'] == 1
+
+
+class TestCounterAndHealthMerge:
+    def test_counters_add_and_skip_non_additive(self):
+        totals = merge_counters([
+            {'items_out': 3, 'window_s': 5.0, 'decode_p99_s': 0.2,
+             '_private': 9, 'flag': True},
+            {'items_out': 4, 'io_overlap_fraction': 0.5},
+            None,
+        ])
+        assert totals == {'items_out': 7}
+
+    def test_health_worst_of_names_the_host(self):
+        merged = merge_health({
+            'host_a:1': {'state': HEALTHY},
+            'host_b:2': {'state': DEGRADED,
+                         'degraded_causes': ['slow_object_store']},
+            'host_c:3': {'state': STALLED, 'hint': 'wedged decode'},
+        })
+        assert merged['state'] == STALLED
+        assert 'host_b:2: slow_object_store' in merged['causes']
+        assert merged['by_host']['host_c:3']['hint'] == 'wedged decode'
+
+    def test_unknown_state_is_never_healthy(self):
+        merged = merge_health({'host_a:1': {'state': 'gibberish'}})
+        assert merged['state'] == 'gibberish'
+
+
+class TestCertificate:
+    def test_exact_fills_pass(self):
+        cert = check_pod_certificate({'fills': 4, 'peer_hits': 8}, 4)
+        assert cert['ok'] is True and cert['problems'] == []
+
+    def test_forged_duplicate_fill_fails(self):
+        cert = check_pod_certificate({'fills': 5, 'peer_hits': 8}, 4)
+        assert cert['ok'] is False
+        assert any('duplicate fills' in p for p in cert['problems'])
+
+    def test_missing_fill_fails(self):
+        cert = check_pod_certificate({'fills': 3}, 4)
+        assert cert['ok'] is False
+        assert any('missing fills' in p for p in cert['problems'])
+
+    def test_unreachable_host_refuses_to_certify(self):
+        # exact fills, but a host is dark: the denominator is incomplete
+        cert = check_pod_certificate({'fills': 4}, 4,
+                                     unreachable=['10.0.0.9:7777'])
+        assert cert['ok'] is False
+        assert any(PARTIAL_POD in p for p in cert['problems'])
+
+    def test_unarmed_certificate_is_never_a_silent_pass(self):
+        assert check_pod_certificate({'fills': 4})['ok'] is None
+
+    def test_observer_merge_raises_on_forged_fill(self):
+        observer = PodObserver(['127.0.0.1:1'], expected_row_groups=4)
+        report = observer.merge([
+            {'host': 'a', 'cache': {'fills': 3, 'peer_hits': 1}},
+            {'host': 'b', 'cache': {'fills': 2, 'peer_hits': 0}},
+        ])
+        assert report['certificate']['ok'] is False
+        with pytest.raises(PodCertificateError, match='duplicate fills'):
+            observer.assert_certificate(report)
+
+
+def _serve_observer_host(snapshot=None, health=None, cache=None,
+                         span_tail=None, host='sim_host'):
+    observe_fn = make_observe_fn(
+        snapshot_fn=(lambda: dict(snapshot)) if snapshot else None,
+        health_fn=(lambda: dict(health)) if health else None,
+        cache_counters_fn=(lambda: dict(cache)) if cache else None,
+        span_tail_fn=(lambda: list(span_tail)) if span_tail else None,
+        host=host)
+    return DebugServer(lambda: {'state': HEALTHY},
+                       observe_fn=observe_fn).start()
+
+
+class TestHttpSurfaces:
+    def test_snapshot_route_serves_one_json_with_pod_headers(self):
+        hist = LatencyHistogram()
+        hist.record(0.02)
+        server = _serve_observer_host(
+            snapshot={'items_out': 5,
+                      LATENCY_HISTOGRAMS_KEY: {'io_range': hist.state()}},
+            health={'state': HEALTHY}, cache={'fills': 2})
+        try:
+            trace_id = new_trace_id()
+            status, body, headers = _http_get(
+                server.port, podobs.SNAPSHOT_ROUTE,
+                headers={TRACE_HEADER: trace_id})
+            assert status == 200
+            blob = json.loads(body)
+            assert blob['kind'] == 'petastorm_tpu.observe_snapshot'
+            assert blob['host'] == 'sim_host'
+            assert blob['stats']['items_out'] == 5
+            assert LATENCY_HISTOGRAMS_KEY not in blob['stats']
+            assert blob['latency_histograms']['io_range']['count'] == 1
+            assert blob['cache'] == {'fills': 2}
+            # clock header for offset estimation + trace-id echo
+            float(headers[CLOCK_HEADER])
+            assert headers[TRACE_HEADER] == trace_id
+        finally:
+            server.stop()
+
+    def test_pod_report_over_http_with_dead_peer_named(self):
+        hist_a, hist_b = LatencyHistogram(), LatencyHistogram()
+        for seconds in _sample_latencies(seed=3):
+            hist_a.record(seconds)
+            hist_b.record(seconds * 2)
+        servers = [
+            _serve_observer_host(
+                snapshot={'items_out': 10,
+                          LATENCY_HISTOGRAMS_KEY: {'io_range':
+                                                   hist_a.state()}},
+                health={'state': HEALTHY}, cache={'fills': 3,
+                                                  'peer_hits': 0},
+                host='host_a'),
+            _serve_observer_host(
+                snapshot={'items_out': 20,
+                          LATENCY_HISTOGRAMS_KEY: {'io_range':
+                                                   hist_b.state()}},
+                health={'state': DEGRADED}, cache={'fills': 1,
+                                                   'peer_hits': 3},
+                host='host_b'),
+        ]
+        dead = '127.0.0.1:9'   # discard port: nothing ever listens
+        try:
+            peers = ['127.0.0.1:{}'.format(s.port) for s in servers]
+            observer = PodObserver(peers + [dead], timeout_s=0.5,
+                                   expected_row_groups=4)
+            report = observer.report()
+            # the dead host is NAMED, never a silently shrunk denominator
+            assert report['verdict'] == PARTIAL_POD
+            assert report['hosts_reporting'] == 2
+            assert [u['peer'] for u in report['unreachable']] == [dead]
+            assert report['certificate']['ok'] is False
+            with pytest.raises(PodCertificateError, match=PARTIAL_POD):
+                observer.assert_certificate(report)
+            # the reachable hosts still merged: counters by addition,
+            # histograms bit-identical to direct recording
+            assert report['counters']['items_out'] == 30
+            direct = merge_histogram_states(
+                [{'io_range': hist_a.state()},
+                 {'io_range': hist_b.state()}])
+            assert (report['latency_histograms']['io_range']['buckets']
+                    == direct['io_range']['buckets'])
+            assert report['health']['state'] == DEGRADED
+            # clock offsets were estimated for every host that answered
+            assert all(isinstance(h['clock_offset_s'], float)
+                       for h in report['hosts'])
+        finally:
+            for server in servers:
+                server.stop()
+
+    def test_podmetrics_route_serves_the_aggregation(self):
+        backend = _serve_observer_host(health={'state': HEALTHY},
+                                       cache={'fills': 2, 'peer_hits': 0},
+                                       host='backend')
+        front = None
+        try:
+            observer = PodObserver(['127.0.0.1:{}'.format(backend.port)],
+                                   expected_row_groups=2)
+            front = DebugServer(lambda: {'state': HEALTHY},
+                                podmetrics_fn=observer.report).start()
+            status, body, _ = _http_get(front.port, podobs.PODMETRICS_ROUTE)
+            assert status == 200
+            blob = json.loads(body)
+            assert blob['kind'] == 'petastorm_tpu.podmetrics'
+            assert blob['certificate']['ok'] is True
+            assert blob['certificate']['fills'] == 2
+        finally:
+            backend.stop()
+            if front is not None:
+                front.stop()
+
+
+def _mk_cache(tmp_path, name, **kwargs):
+    return SharedRowGroupCache(str(tmp_path / name), 1 << 24,
+                               mem_dir=str(tmp_path / (name + '_mem')),
+                               **kwargs)
+
+
+class TestPeerFetchTracing:
+    def test_trace_id_propagates_through_a_real_peer_fetch(self, tmp_path,
+                                                           monkeypatch):
+        monkeypatch.delenv(podobs.PODOBS_ENV_VAR, raising=False)
+        served = _mk_cache(tmp_path, 'host_a')
+        fetcher = None
+        try:
+            payload = {'a': np.arange(512, dtype=np.int64)}
+            served.get('rg0', lambda: payload)
+            port = served.serve_peers()
+            # the peer-cache endpoint echoes the trace id and stamps its
+            # monotonic clock on every reply (hit or miss alike)
+            trace_id = new_trace_id()
+            status, _, headers = _http_get(
+                port, '/peercache/deadbeef',
+                headers={TRACE_HEADER: trace_id})
+            assert status == 404
+            assert headers[TRACE_HEADER] == trace_id
+            float(headers[CLOCK_HEADER])
+
+            fetcher = _mk_cache(tmp_path, 'host_b',
+                                peers=['127.0.0.1:{}'.format(port)])
+            got = fetcher.get('rg0', lambda: pytest.fail('must peer-hit'))
+            np.testing.assert_array_equal(got['a'], payload['a'])
+            spans = fetcher.take_spans()
+            assert spans and fetcher.take_spans() == []  # drained
+            names = [s[0] for s in spans]
+            assert 'peer_fetch' in names
+            span = spans[names.index('peer_fetch')]
+            assert span[4]['outcome'] == 'hit'
+            assert span[4]['bytes'] > 0
+            latency = fetcher.take_latency()
+            assert latency and latency['peer_fetch']['count'] >= 1
+        finally:
+            if fetcher is not None:
+                fetcher.close()
+            served.close()
+
+
+class TestKillSwitch:
+    def test_kill_switch_means_no_threads_routes_spans_or_files(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv(podobs.PODOBS_ENV_VAR, '0')
+        threads_before = threading.active_count()
+
+        # no routes: a server wired the way the reader wires it when the
+        # plane is off (observe_fn/podmetrics_fn stay None) 404s both
+        server = DebugServer(lambda: {'state': HEALTHY}).start()
+        try:
+            assert _http_get(server.port, podobs.SNAPSHOT_ROUTE)[0] == 404
+            assert _http_get(server.port, podobs.PODMETRICS_ROUTE)[0] == 404
+        finally:
+            server.stop()
+
+        # no spans, no latency, no pod headers from the cache plane
+        served = _mk_cache(tmp_path, 'host_a')
+        fetcher = None
+        try:
+            served.get('rg0', lambda: {'a': np.zeros(8, dtype=np.int64)})
+            port = served.serve_peers()
+            _, _, headers = _http_get(port, '/peercache/deadbeef')
+            assert TRACE_HEADER not in headers
+            assert CLOCK_HEADER not in headers
+            fetcher = _mk_cache(tmp_path, 'host_b',
+                                peers=['127.0.0.1:{}'.format(port)])
+            fetcher.get('rg0', lambda: pytest.fail('must peer-hit'))
+            assert fetcher.take_spans() == []
+            assert fetcher.take_latency() is None
+        finally:
+            if fetcher is not None:
+                fetcher.close()
+            served.close()
+
+        # no threads: the observer polls on the caller's thread only
+        observer = PodObserver(['127.0.0.1:9'], timeout_s=0.2)
+        observer.merge([{'host': 'a', 'cache': {'fills': 1}}])
+        assert threading.active_count() == threads_before
+        # no files: nothing under tmp_path besides the cache's own dirs
+        stray = [p for p in tmp_path.rglob('*')
+                 if 'podobs' in p.name or p.suffix == '.trace']
+        assert stray == []
