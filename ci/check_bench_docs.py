@@ -62,12 +62,14 @@ MIN_ANNOTATIONS = 30
 #: chaos hedged-vs-unhedged tail-latency + clean-path-overhead record;
 #: round-18 adds BENCH_r18, the object-store ranged-read + recorded-trace
 #: + pod-dedup record; round-19 adds BENCH_r19, the pod-observability
-#: overhead + K-host merged-certificate record).
+#: overhead + K-host merged-certificate record; round-20 adds BENCH_r20,
+#: the elastic pod membership clean-path-overhead + host-death-recovery
+#: record).
 REQUIRED_ARTIFACTS = ('BENCH_r06.json', 'BENCH_r07.json', 'BENCH_r08.json',
                       'BENCH_r09.json', 'BENCH_r10.json', 'BENCH_r11.json',
                       'BENCH_r12.json', 'BENCH_r13.json', 'BENCH_r14.json',
                       'BENCH_r15.json', 'BENCH_r16.json', 'BENCH_r18.json',
-                      'BENCH_r19.json')
+                      'BENCH_r19.json', 'BENCH_r20.json')
 
 def check_artifacts_intact(root: str = ROOT):
     """Reject any committed ``BENCH_*.json`` that carries a ``parsed`` key

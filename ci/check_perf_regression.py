@@ -369,6 +369,45 @@ def _podobs_entries(artifact, round_no, blob):
     return entries
 
 
+def _podelastic_entries(artifact, round_no, blob):
+    """Entries from the elastic pod membership benchmark (r20): the
+    lease-plane-off baseline under the recorded trace, the elastic-on
+    clean-path rate (its %-of-baseline is the default-off plane's
+    when-armed overhead claim), and the host-death recovery rate vs the
+    simulated full-restart alternative."""
+    entries = []
+    clean = blob.get('clean') or {}
+    trace = blob.get('trace') or {}
+    config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+              'rows': blob.get('rows'), 'k_hosts': blob.get('k_hosts'),
+              'trace': trace.get('name'), 'seed': trace.get('seed'),
+              'pairs': clean.get('pairs')}
+    baseline = clean.get('baseline_samples_per_s')
+    if isinstance(baseline, (int, float)):
+        entries.append(_entry(artifact, round_no,
+                              'podelastic.clean_baseline', config, baseline))
+    on_rate = clean.get('elastic_on_samples_per_s')
+    if isinstance(on_rate, (int, float)):
+        roof = blob.get('roofline') or {}
+        entries.append(_entry(artifact, round_no,
+                              'podelastic.clean_elastic_on', config, on_rate,
+                              roofline_pct=roof.get('roofline_pct')))
+    recovery = blob.get('recovery') or {}
+    elastic_rate = recovery.get('elastic_samples_per_s')
+    if isinstance(elastic_rate, (int, float)):
+        restart_rate = recovery.get('restart_samples_per_s')
+        roofline_pct = None
+        if isinstance(baseline, (int, float)) and baseline:
+            roofline_pct = round(100.0 * elastic_rate / baseline, 2)
+        recovery_config = dict(config,
+                               restart_samples_per_s=restart_rate,
+                               speedup_x=recovery.get('speedup_x'))
+        entries.append(_entry(artifact, round_no,
+                              'podelastic.recovery_elastic', recovery_config,
+                              elastic_rate, roofline_pct=roofline_pct))
+    return entries
+
+
 def _shared_cache_entries(artifact, round_no, blob):
     """Entries from the shared-cache protocol record (r11): the measured
     serial roofline and the aggregate fleet rate."""
@@ -424,6 +463,8 @@ def normalize_artifact(name: str, blob: dict):
         entries.extend(_objectstore_entries(name, round_no, payload))
     elif payload.get('benchmark', '') == 'podobs':
         entries.extend(_podobs_entries(name, round_no, payload))
+    elif payload.get('benchmark', '') == 'podelastic':
+        entries.extend(_podelastic_entries(name, round_no, payload))
     elif 'baseline_items_per_s' in payload:
         entries.extend(_overhead_entries(name, round_no, payload))
     elif 'shared' in payload and 'roofline' in payload:

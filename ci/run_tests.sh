@@ -101,6 +101,12 @@ PETASTORM_TPU_LOCKDEP=1 python -m pytest tests/test_podobs.py -q
 echo '== pod-observability quick bench (overhead A/B under the recorded trace + K-host merged certificate) =='
 JAX_PLATFORMS=cpu python -m petastorm_tpu.benchmark.podobs --quick
 
+echo '== pod-elasticity quick checks (membership/lease/ledger, host-death/join chaos, exactly-once certificate; lockdep on) =='
+PETASTORM_TPU_LOCKDEP=1 JAX_PLATFORMS=cpu python -m pytest tests/test_podelastic.py -q
+
+echo '== pod-elasticity quick bench (clean-path overhead under the recorded trace + host-death recovery vs restart) =='
+JAX_PLATFORMS=cpu python -m petastorm_tpu.benchmark.podelastic --quick
+
 echo '== profiler quick checks (attribution, calibration cache, advisor, /profile) =='
 python -m pytest tests/test_profiler.py -q
 

@@ -19,6 +19,7 @@ __all__ = ['make_reader', 'make_batch_reader', 'make_columnar_reader',
            'LatencyHistogram', 'SLOMonitor',
            'PipelineController', 'PodObserver',
            'RetryPolicy', 'HedgedRead', 'FaultInjector',
+           'ElasticPodSim', 'PodMembership', 'LeasePlan',
            '__version__']
 
 
@@ -66,4 +67,7 @@ def __getattr__(name):
     if name == 'FaultInjector':
         from petastorm_tpu.faultfs import FaultInjector
         return FaultInjector
+    if name in ('ElasticPodSim', 'PodMembership', 'LeasePlan'):
+        from petastorm_tpu import podelastic
+        return getattr(podelastic, name)
     raise AttributeError('module {!r} has no attribute {!r}'.format(__name__, name))

@@ -76,9 +76,27 @@ class CheckpointableLoader(object):
                 'version': 1}
 
     def load_state_dict(self, state: Dict[str, int]) -> None:
-        if state.get('version', 1) != 1:
-            raise ValueError('Unknown checkpoint state version {}'.format(
-                state.get('version')))
+        """Restore the cursor. Rejects a state dict with a missing or
+        unknown schema ``version``, or missing cursor keys, **loudly** —
+        silently fast-forwarding from garbage (a truncated checkpoint, a
+        key renamed by some serializer) would resume training at the wrong
+        position without any symptom."""
+        if not isinstance(state, dict):
+            raise ValueError('checkpoint state must be a dict, got '
+                             '{!r}'.format(type(state).__name__))
+        if 'version' not in state:
+            raise ValueError("checkpoint state has no 'version' key — it "
+                             'was not produced by state_dict() (keys: '
+                             '{})'.format(sorted(state)))
+        if state['version'] != 1:
+            raise ValueError('Unknown checkpoint state version {!r} '
+                             '(this build reads version 1)'.format(
+                                 state['version']))
+        missing = [k for k in ('epoch', 'step') if k not in state]
+        if missing:
+            raise ValueError('checkpoint state is missing key(s) {} '
+                             '(keys present: {})'.format(
+                                 missing, sorted(state)))
         self.epoch = int(state['epoch'])
         self.step = 0
         self._skip = int(state['step'])

@@ -40,6 +40,15 @@ scenario                  injected faults
                           deterministic per (seed, path, range, occurrence), so
                           hedge thresholds and range planning are tuned against
                           a realistic S3-shaped tail without cloud credentials
+``host-death``            after ``die_after_batches`` delivered batches the
+                          chosen host (``die_host``; ``-1`` = seed-derived)
+                          raises ``podelastic.SimulatedHostDeath`` — the
+                          elasticity plane's survivors must absorb its leases
+                          (at most ``max_deaths`` per process)
+``host-join``             after ``join_after_batches`` pod-wide delivered
+                          batches a new host joins the pod and triggers a
+                          bounded rebalance (at most ``max_joins`` per
+                          process)
 ========================  ====================================================
 
 Harness hook: set ``PETASTORM_TPU_CHAOS='<scenario>:<seed>'`` (e.g.
@@ -87,6 +96,11 @@ SCENARIOS: Dict[str, dict] = {
     # benchmark/traces/ (e.g. 's3-us-east-1'); scales stretch/shrink the
     # recorded samples without re-recording
     'trace-replay': dict(trace='', latency_scale=1.0, bandwidth_scale=1.0),
+    # pod-elasticity scenarios (consulted by petastorm_tpu.podelastic, NOT
+    # the filesystem wrapper): kill one simulated host mid-epoch / admit a
+    # late joiner. die_host=-1 derives the victim from the seed.
+    'host-death': dict(die_host=-1, die_after_batches=3, max_deaths=1),
+    'host-join': dict(join_after_batches=3, max_joins=1),
 }
 
 
@@ -155,6 +169,7 @@ class FaultInjector:
         self._occurrences: Dict[tuple, int] = {}
         self._cooldown: Dict[str, int] = {}
         self._kills = 0
+        self._joins = 0
         self._reads = 0
         #: Injection tally by fault kind (diagnostics + test assertions).
         self.injected: Dict[str, int] = {}
@@ -311,6 +326,53 @@ class FaultInjector:
         if delay > 0:
             time.sleep(delay)
 
+    # -- pod-elasticity hooks --------------------------------------------------
+
+    def should_kill_host(self, host_index: int, batches_delivered: int) -> bool:
+        """Consulted by ``podelastic.ElasticHost`` before each delivery step:
+        True when this simulated host must die *now* (raise
+        ``SimulatedHostDeath``). ``die_host`` picks the victim by index;
+        ``die_host=-1`` derives it from the seed (deterministically, without
+        needing to know the pod size: the draw selects a small index, and the
+        first host at-or-above it to cross ``die_after_batches`` dies —
+        replayable under the elasticity plane's round-robin stepping)."""
+        if self.scenario != 'host-death':
+            return False
+        p = self.params
+        if batches_delivered < p['die_after_batches']:
+            return False
+        die_host = int(p['die_host'])
+        if die_host < 0:
+            # seed-derived victim in [0, 4): pods smaller than the draw fall
+            # through to the >= test below, so some host always dies
+            die_host = int(self._uniform('pod', 'host-death', 0) * 4)
+        with self._lock:
+            if self._kills >= p['max_deaths']:
+                return False
+            if host_index != die_host and not (
+                    int(p['die_host']) < 0 and host_index >= die_host):
+                return False
+            self._kills += 1
+        self._count('host_death')
+        return True
+
+    def should_join_host(self, batches_delivered: int) -> bool:
+        """Consulted by ``podelastic.ElasticPodSim`` between delivery steps:
+        True when a new simulated host must join the pod *now* (at most
+        ``max_joins`` per process, after ``join_after_batches`` pod-wide
+        delivered batches)."""
+        if self.scenario != 'host-join':
+            return False
+        p = self.params
+        if batches_delivered < p['join_after_batches']:
+            return False
+        with self._lock:
+            if self._joins >= p['max_joins']:
+                return False
+            self._joins += 1
+        self._count('host_join')
+        return True
+
     # -- cache-side hook -------------------------------------------------------
 
     def cache_put_fault(self, key: str) -> None:
@@ -391,7 +453,8 @@ class FaultyFilesystem:
 # -- the PETASTORM_TPU_CHAOS harness hook -------------------------------------
 
 #: Scenarios injecting at the filesystem layer (everything except the
-#: cache-publication fault, which arms inside the shared cache instead).
+#: cache-publication fault, which arms inside the shared cache, and the
+#: pod-elasticity scenarios, which arm inside podelastic's delivery loop).
 _FS_SCENARIOS = frozenset({'transient-errors', 'tail-latency', 'read-hangs',
                            'truncated-reads', 'worker-kill',
                            'fixed-latency', 'trace-replay'})

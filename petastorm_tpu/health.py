@@ -315,6 +315,17 @@ def degradation_causes(snapshot: dict) -> List[str]:
     if n:
         causes.append('io-permanent-failures: {} read(s) failed with '
                       'non-retryable errors'.format(n))
+    n = snapshot.get('hosts_died', 0)
+    if n:
+        dead = snapshot.get('dead_hosts') or ()
+        who = ' ({})'.format(', '.join(dead)) if dead else ''
+        causes.append('host-death: {} pod member(s) died{}; their shard '
+                      'leases were rebalanced onto survivors '
+                      '(docs/robustness.md)'.format(n, who))
+    n = snapshot.get('leases_rebalanced', 0)
+    if n and not snapshot.get('hosts_died', 0):
+        causes.append('lease-rebalance: {} shard lease(s) moved after a '
+                      'pod membership change (host join)'.format(n))
     return causes
 
 
@@ -419,7 +430,8 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
                         roofline: Optional[dict] = None,
                         latency: Optional[dict] = None,
                         slo: Optional[dict] = None,
-                        autotune: Optional[dict] = None) -> dict:
+                        autotune: Optional[dict] = None,
+                        elastic: Optional[dict] = None) -> dict:
     """Assemble the flight-recorder artifact: everything needed to diagnose
     a stall *after* the process is gone. JSON-able by construction.
     ``lineage`` (a tracker's ``flight_summary()``) adds the coverage audit
@@ -436,7 +448,10 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
     ``autotune`` (a ``PipelineController.flight_summary()``) records the
     controller's recent knob moves and prediction grades — a stall that
     follows a controller action must be attributable to it
-    (``docs/autotune.md``)."""
+    (``docs/autotune.md``); ``elastic`` (an ``ElasticHost.elastic_snapshot()``)
+    records this host's pod-membership view — held leases, hosts joined/died,
+    leases rebalanced — so a stall after a membership change is attributable
+    to the rebalance (``docs/robustness.md``)."""
     record = {
         'kind': 'petastorm_tpu_flight_record',
         # deliberate wall clock: a human-facing artifact timestamp, never
@@ -462,6 +477,8 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
         record['slo'] = slo
     if autotune is not None:
         record['autotune'] = autotune
+    if elastic is not None:
+        record['elastic'] = elastic
     return record
 
 

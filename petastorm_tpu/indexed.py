@@ -486,8 +486,23 @@ class IndexedBatchLoader:
         return {'epoch': self.epoch, 'batch': self.batch, 'version': 1}
 
     def load_state_dict(self, state: Dict[str, int]) -> None:
-        if state.get('version', 1) != 1:
-            raise ValueError('Unknown state version {}'.format(state.get('version')))
+        """Restore the cursor; rejects missing/unknown ``version`` and
+        missing cursor keys loudly (the checkpoint.py contract — resuming
+        from a garbage dict must fail at load, not misposition training)."""
+        if not isinstance(state, dict):
+            raise ValueError('loader state must be a dict, got '
+                             '{!r}'.format(type(state).__name__))
+        if 'version' not in state:
+            raise ValueError("loader state has no 'version' key — it was "
+                             'not produced by state_dict() (keys: '
+                             '{})'.format(sorted(state)))
+        if state['version'] != 1:
+            raise ValueError('Unknown state version {!r} (this build reads '
+                             'version 1)'.format(state['version']))
+        missing = [k for k in ('epoch', 'batch') if k not in state]
+        if missing:
+            raise ValueError('loader state is missing key(s) {} (keys '
+                             'present: {})'.format(missing, sorted(state)))
         self.epoch = int(state['epoch'])
         self.batch = int(state['batch'])
         if self.batch >= self.batches_per_epoch:

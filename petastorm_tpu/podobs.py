@@ -104,7 +104,11 @@ VERDICT_ORDER = ('healthy', 'degraded', 'starving', 'stalled')
 #: fractions would double-count, percentile estimates must come from the
 #: merged histograms instead (suffix-matched below).
 _NON_ADDITIVE_KEYS = frozenset({'window_s', 'io_overlap_fraction', 'pid',
-                                'epoch'})
+                                'epoch',
+                                # pod-wide constants every elastic host
+                                # reports identically: summing K copies
+                                # would inflate the certificate denominator
+                                'expected_batches'})
 _NON_ADDITIVE_SUFFIXES = ('_p50_s', '_p90_s', '_p99_s', '_p999_s',
                           '_fraction')
 
@@ -161,6 +165,7 @@ def make_observe_fn(snapshot_fn: Optional[Callable[[], dict]] = None,
                     coverage_fn: Optional[Callable[[], dict]] = None,
                     cache_counters_fn: Optional[Callable[[], dict]] = None,
                     span_tail_fn: Optional[Callable[[], list]] = None,
+                    elastic_fn: Optional[Callable[[], dict]] = None,
                     host: Optional[str] = None) -> Callable[[], dict]:
     """Build the ``observe_fn`` a ``DebugServer`` serves on
     :data:`SNAPSHOT_ROUTE`: one JSON-able dict with every per-host surface
@@ -199,6 +204,7 @@ def make_observe_fn(snapshot_fn: Optional[Callable[[], dict]] = None,
             'coverage': _section(coverage_fn),
             'cache': _section(cache_counters_fn),
             'span_tail': _section(span_tail_fn),
+            'elastic': _section(elastic_fn),
         }
         return snap
 
@@ -298,14 +304,27 @@ class PodCertificateError(AssertionError):
 
 def check_pod_certificate(cache_totals: Optional[dict],
                           expected_row_groups: Optional[int] = None,
-                          unreachable: Sequence[str] = ()) -> dict:
+                          unreachable: Sequence[str] = (),
+                          elastic_totals: Optional[dict] = None,
+                          expected_batches: Optional[int] = None) -> dict:
     """Machine-check the pod decode-once certificate from summed
     shared-cache counters: ``sum(fills) == distinct row groups`` (every
     row group decoded exactly once somewhere in the pod), with
     ``peer_hits`` tallied as the dedup evidence. An unreachable host makes
     the certificate **uncheckable** — its fills are missing from the sum,
     so the denominator silently shrank; that is reported as a named
-    problem, never as a pass."""
+    problem, never as a pass.
+
+    When the elasticity plane is on, ``elastic_totals`` (summed
+    ``ElasticHost.elastic_snapshot()`` counters) and ``expected_batches``
+    (the lease grid's total) extend the certificate to **exactly-once row
+    delivery across membership changes**: ``sum(batches_delivered)`` must
+    equal the grid total — more means a batch was delivered twice across a
+    rebalance, fewer means one was dropped — with
+    ``batches_skipped_claimed`` tallied as the fencing evidence (a takeover
+    host that found the batch already claimed and did NOT re-deliver it).
+    The per-lease naming of any duplicate/drop (host + path + row group)
+    comes from ``podelastic.ElasticCoverageAuditor``."""
     cache_totals = cache_totals or {}
     fills = int(cache_totals.get('fills', 0) or 0)
     peer_hits = int(cache_totals.get('peer_hits', 0) or 0)
@@ -331,19 +350,47 @@ def check_pod_certificate(cache_totals: Optional[dict],
                 'missing fills: {} fills recorded for {} distinct row '
                 'groups — either the run is incomplete or a fill counter '
                 'was lost'.format(fills, expected))
+    elastic_totals = elastic_totals or {}
+    delivered = int(elastic_totals.get('batches_delivered', 0) or 0)
+    elastic_checked = expected_batches is not None and not unreachable
+    if elastic_checked:
+        expected_b = int(expected_batches)  # type: ignore[arg-type]
+        if delivered > expected_b:
+            problems.append(
+                'duplicate delivery: {} batches delivered for a {}-batch '
+                'lease grid — some batch was delivered more than once '
+                'across a rebalance (the delivery claim fence was '
+                'bypassed)'.format(delivered, expected_b))
+        elif delivered < expected_b:
+            problems.append(
+                'dropped delivery: {} batches delivered for a {}-batch '
+                'lease grid — a batch was lost across a membership '
+                'change'.format(delivered, expected_b))
     ok: Optional[bool]
     if unreachable:
         ok = False
-    elif checked:
+    elif checked or elastic_checked:
         ok = not problems
     else:
         ok = None   # nothing to certify against; never a silent pass
-    return {'fills': fills, 'peer_hits': peer_hits,
-            'peer_misses': int(cache_totals.get('peer_misses', 0) or 0),
-            'peer_errors': int(cache_totals.get('peer_errors', 0) or 0),
-            'expected_row_groups': expected_row_groups,
-            'unreachable': unreachable,
-            'checked': checked, 'ok': ok, 'problems': problems}
+    certificate = {'fills': fills, 'peer_hits': peer_hits,
+                   'peer_misses': int(cache_totals.get('peer_misses', 0) or 0),
+                   'peer_errors': int(cache_totals.get('peer_errors', 0) or 0),
+                   'expected_row_groups': expected_row_groups,
+                   'unreachable': unreachable,
+                   'checked': checked, 'ok': ok, 'problems': problems}
+    if expected_batches is not None or elastic_totals:
+        certificate['elastic'] = {
+            'batches_delivered': delivered,
+            'batches_skipped_claimed': int(
+                elastic_totals.get('batches_skipped_claimed', 0) or 0),
+            'leases_rebalanced': int(
+                elastic_totals.get('leases_rebalanced', 0) or 0),
+            'rows_resumed': int(elastic_totals.get('rows_resumed', 0) or 0),
+            'expected_batches': expected_batches,
+            'checked': elastic_checked,
+        }
+    return certificate
 
 
 # -- the aggregator -----------------------------------------------------------
@@ -365,12 +412,14 @@ class PodObserver:
 
     def __init__(self, peers, timeout_s: float = DEFAULT_TIMEOUT_S,
                  expected_row_groups: Optional[int] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 expected_batches: Optional[int] = None):
         self.peers = parse_peers(peers)
         if not self.peers:
             raise ValueError('PodObserver needs at least one host:port peer')
         self.timeout_s = float(timeout_s)
         self.expected_row_groups = expected_row_groups
+        self.expected_batches = expected_batches
         self.trace_id = trace_id or new_trace_id()
         self.last_report: Optional[dict] = None
 
@@ -430,6 +479,7 @@ class PodObserver:
         hosts = []
         health_by_host: Dict[str, Optional[dict]] = {}
         stats_list, histogram_maps, cache_list = [], [], []
+        elastic_list: List[Optional[dict]] = []
         slo_burns: Dict[str, float] = {}
         hard_breach_hosts: List[str] = []
         coverage_by_host = {}
@@ -449,6 +499,7 @@ class PodObserver:
             stats_list.append(snapshot.get('stats'))
             histogram_maps.append(snapshot.get('latency_histograms'))
             cache_list.append(snapshot.get('cache'))
+            elastic_list.append(snapshot.get('elastic'))
             slo = snapshot.get('slo') or {}
             burn = slo.get('burn_rate')
             if isinstance(burn, (int, float)):
@@ -477,9 +528,12 @@ class PodObserver:
             latency[stage] = entry
         health = merge_health(health_by_host)
         cache_totals = merge_counters(cache_list)
+        elastic_totals = merge_counters(elastic_list)
         certificate = check_pod_certificate(
             cache_totals, self.expected_row_groups,
-            unreachable=[u['peer'] for u in unreachable])
+            unreachable=[u['peer'] for u in unreachable],
+            elastic_totals=elastic_totals,
+            expected_batches=self.expected_batches)
         verdict = PARTIAL_POD if unreachable else health['state']
         report = {
             'kind': 'petastorm_tpu.podmetrics',
@@ -504,6 +558,10 @@ class PodObserver:
                       'by_host': {str(h.get('peer') or h.get('host')):
                                   c for h, c in zip(hosts, cache_list)
                                   if c is not None}},
+            'elastic': {'totals': elastic_totals,
+                        'by_host': {str(h.get('peer') or h.get('host')):
+                                    e for h, e in zip(hosts, elastic_list)
+                                    if e is not None}},
             'certificate': certificate,
             'trace_tracks': trace_tracks,
         }

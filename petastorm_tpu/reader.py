@@ -161,14 +161,50 @@ def _relax_hinted_shapes(schema, decode_hints, stored_schema):
     return Unischema(schema._name, fields)
 
 
-def _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process):
+def _validate_shard_range(cur_shard, shard_count):
+    """Fail at the factory with a message naming both values — a bad shard
+    spec must not surface as an empty iterator or a ventilator IndexError
+    deep inside the pipeline."""
+    if cur_shard is None and shard_count is None:
+        return
+    if (cur_shard is None) != (shard_count is None):
+        raise ValueError('cur_shard and shard_count must be specified together '
+                         '(got cur_shard={!r}, shard_count={!r})'.format(
+                             cur_shard, shard_count))
+    if shard_count < 1:
+        raise ValueError('shard_count must be a positive integer, got '
+                         'shard_count={!r} (with cur_shard={!r})'.format(
+                             shard_count, cur_shard))
+    if cur_shard < 0:
+        raise ValueError('cur_shard must be non-negative, got cur_shard={!r} '
+                         '(with shard_count={!r})'.format(
+                             cur_shard, shard_count))
+    if cur_shard >= shard_count:
+        raise ValueError('cur_shard must be < shard_count, got cur_shard={!r} '
+                         'for shard_count={!r}'.format(cur_shard, shard_count))
+
+
+def _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process,
+                       elastic=None):
+    if elastic is not None:
+        # lease-driven shard assignment: the elasticity plane derives
+        # (cur_shard, shard_count) from the live pod membership (import is
+        # local so the default-off plane costs nothing when unused)
+        from petastorm_tpu.podelastic import resolve_elastic_shard
+        cur_shard, shard_count, _ = resolve_elastic_shard(
+            elastic, cur_shard, shard_count, shard_by_jax_process)
+        _validate_shard_range(cur_shard, shard_count)
+        return cur_shard, shard_count
     if not shard_by_jax_process:
+        _validate_shard_range(cur_shard, shard_count)
         return cur_shard, shard_count
     if cur_shard is not None or shard_count is not None:
         raise ValueError('shard_by_jax_process is mutually exclusive with explicit '
                          'cur_shard/shard_count')
     import jax
-    return jax.process_index(), jax.process_count()
+    cur_shard, shard_count = jax.process_index(), jax.process_count()
+    _validate_shard_range(cur_shard, shard_count)
+    return cur_shard, shard_count
 
 
 def make_reader(dataset_url,
@@ -187,7 +223,7 @@ def make_reader(dataset_url,
                 metrics_out=None, debug_port=None, stall_timeout=0,
                 flight_record_dir=None, on_decode_error='raise',
                 slo=None, autotune=False, retry=None, hedge=None,
-                remote_read=None, worker_recovery=None):
+                remote_read=None, worker_recovery=None, elastic=None):
     """Row-granular reader for petastorm_tpu datasets (codec-decoded rows).
 
     Mirrors the reference factory (``reader.py:61-195``). Raises a helpful error
@@ -262,6 +298,14 @@ def make_reader(dataset_url,
     (pyarrow-coalesced column chunks), ``'ranged'`` (explicit footer-planned
     parallel range fetches; retry/hedge then apply per RANGE, not per row
     group). Default auto: ``prebuffer`` for object stores, ``serial`` local.
+
+    ``elastic=`` (a ``{'coord_root': ...}`` dict; default off, kill switch
+    ``PETASTORM_TPU_ELASTIC=0``) derives ``(cur_shard, shard_count)`` from
+    the live pod membership instead of static arguments — a **snapshot**
+    taken at construction; mid-epoch host death/join rebalancing lives in
+    the lease-grid plane (``petastorm_tpu.podelastic``,
+    ``docs/robustness.md``). Mutually exclusive with explicit
+    ``cur_shard``/``shard_count`` and ``shard_by_jax_process``.
     """
     dataset_url = normalize_dataset_url_or_urls(dataset_url)
     fs, path, factory = get_filesystem_and_path_or_paths(dataset_url, storage_options)
@@ -284,7 +328,8 @@ def make_reader(dataset_url,
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
                       ZeroCopySerializer(), zmq_copy_buffers, profiling_enabled,
                       tracer=tracer, recovery=resolve_recovery(worker_recovery))
-    cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
+    cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count,
+                                                 shard_by_jax_process, elastic)
     return Reader(factory, path,
                   worker_class=RowGroupWorker,
                   results_reader_factory=RowGroupResultsReader,
@@ -322,7 +367,8 @@ def make_columnar_reader(dataset_url,
                          metrics_out=None, debug_port=None, stall_timeout=0,
                          flight_record_dir=None, on_decode_error='raise',
                          slo=None, autotune=False, retry=None, hedge=None,
-                         remote_read=None, worker_recovery=None):
+                         remote_read=None, worker_recovery=None,
+                         elastic=None):
     """Vectorized codec-decoded reader for petastorm_tpu datasets.
 
     Yields **batch namedtuples of decoded numpy column arrays** (one per row
@@ -360,7 +406,8 @@ def make_columnar_reader(dataset_url,
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
                       ZeroCopySerializer(), zmq_copy_buffers, profiling_enabled,
                       tracer=tracer, recovery=resolve_recovery(worker_recovery))
-    cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
+    cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count,
+                                                 shard_by_jax_process, elastic)
     return Reader(factory, path,
                   worker_class=ColumnarWorker,
                   results_reader_factory=ColumnarResultsReader,
@@ -396,7 +443,7 @@ def make_batch_reader(dataset_url_or_urls,
                       stall_timeout=0, flight_record_dir=None,
                       on_decode_error='raise', slo=None, autotune=False,
                       retry=None, hedge=None, remote_read=None,
-                      worker_recovery=None):
+                      worker_recovery=None, elastic=None):
     """Vectorized batch reader for arbitrary parquet stores
     (reference ``reader.py:198-327``). Yields namedtuples of column arrays,
     one per row group. ``io_readahead`` prefetches upcoming row-group reads
@@ -419,7 +466,8 @@ def make_batch_reader(dataset_url_or_urls,
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
                       ArrowTableSerializer(), zmq_copy_buffers, profiling_enabled,
                       tracer=tracer, recovery=resolve_recovery(worker_recovery))
-    cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count, shard_by_jax_process)
+    cur_shard, shard_count = _resolve_jax_shard(cur_shard, shard_count,
+                                                 shard_by_jax_process, elastic)
     return Reader(factory, path,
                   worker_class=ArrowBatchWorker,
                   results_reader_factory=BatchResultsReader,

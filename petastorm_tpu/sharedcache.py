@@ -568,7 +568,8 @@ class SharedRowGroupCache(CacheBase):
                  cleanup: bool = False,
                  peers: Optional[List[str]] = None,
                  peer_timeout_s: float = 2.0,
-                 peer_hedge_s: Optional[float] = None):
+                 peer_hedge_s: Optional[float] = None,
+                 peer_dead_cooldown_s: float = 30.0):
         if not path:
             raise ValueError("cache_type='shared' needs a cache_location "
                              'directory shared by every attaching reader')
@@ -587,6 +588,7 @@ class SharedRowGroupCache(CacheBase):
         self._peers = list(peers or [])
         self._peer_timeout_s = peer_timeout_s
         self._peer_hedge_s = peer_hedge_s
+        self._peer_dead_cooldown_s = float(peer_dead_cooldown_s)
         self._init_runtime()
 
     def _init_runtime(self) -> None:
@@ -608,12 +610,17 @@ class SharedRowGroupCache(CacheBase):
         self._events = {'shared_hits': 0, 'shared_misses': 0,
                         'shared_evictions': 0, 'shared_put_failures': 0,
                         'shared_peer_hits': 0, 'shared_peer_misses': 0,
-                        'shared_peer_errors': 0}
+                        'shared_peer_errors': 0,
+                        'shared_peer_skipped_dead': 0}
         self._totals = {'hits': 0, 'misses': 0, 'fills': 0, 'evictions': 0,
                         'spills': 0, 'corrupt_dropped': 0, 'lock_waits': 0,
                         'lock_steals': 0, 'put_failures': 0,
                         'peer_hits': 0, 'peer_misses': 0, 'peer_errors': 0,
-                        'peer_bytes': 0}
+                        'peer_bytes': 0, 'peer_skipped_dead': 0}
+        # dead-peer cooldown (docs/cache.md): a peer that errored/timed out
+        # is skipped until its monotonic deadline passes, so one dead host
+        # does not tax every subsequent miss with a full peer_timeout_s
+        self._peer_dead_until: Dict[str, float] = {}
         # pod-observability capture (docs/pod_observability.md): per-attempt
         # peer_fetch spans + latency deltas accumulate here (gated on
         # PETASTORM_TPU_PODOBS) until the owning worker drains them via
@@ -686,7 +693,8 @@ class SharedRowGroupCache(CacheBase):
                 'cleanup': self._cleanup_on_exit,
                 'peers': self._peers,
                 'peer_timeout_s': self._peer_timeout_s,
-                'peer_hedge_s': self._peer_hedge_s}
+                'peer_hedge_s': self._peer_hedge_s,
+                'peer_dead_cooldown_s': self._peer_dead_cooldown_s}
 
     def __setstate__(self, state):
         self._path = state['path']
@@ -699,6 +707,7 @@ class SharedRowGroupCache(CacheBase):
         self._peers = state.get('peers', [])
         self._peer_timeout_s = state.get('peer_timeout_s', 2.0)
         self._peer_hedge_s = state.get('peer_hedge_s')
+        self._peer_dead_cooldown_s = state.get('peer_dead_cooldown_s', 30.0)
         self._init_runtime()
 
     # -- lookup ----------------------------------------------------------------
@@ -998,15 +1007,38 @@ class SharedRowGroupCache(CacheBase):
                 continue
         return None
 
+    def _mark_peer_dead(self, peer: str) -> None:
+        """Open ``peer``'s dead-peer cooldown window: subsequent misses
+        skip it (counted ``peer_skipped_dead``) until the monotonic
+        deadline passes, instead of paying the full ``peer_timeout_s`` on
+        every one."""
+        if self._peer_dead_cooldown_s <= 0:
+            return
+        with self._lock:
+            self._peer_dead_until[peer] = (time.perf_counter()
+                                           + self._peer_dead_cooldown_s)
+
     def _peer_fetch(self, digest: str):
         """Try each configured peer for ``digest``: download the segment,
         validate it, republish it into the LOCAL tiers (so one pod transfer
         serves this host's later readers too) and attach. Returns the
         attached ``(payload,)`` or ``None``. A peer that errors is skipped
-        — the pod tier degrades to a local fill, never fails the read."""
+        — the pod tier degrades to a local fill, never fails the read.
+        A peer inside its dead-peer cooldown window (it errored or timed
+        out within the last ``peer_dead_cooldown_s`` seconds) is skipped
+        without a request — counted as ``peer_skipped_dead`` — so a dead
+        host taxes at most one miss per window instead of every one."""
         import urllib.error
         import urllib.request
         for peer in self._peers:
+            with self._lock:
+                dead_until = self._peer_dead_until.get(peer)
+            if dead_until is not None:
+                if time.perf_counter() < dead_until:
+                    self._bump('peer_skipped_dead', 'shared_peer_skipped_dead')
+                    continue
+                with self._lock:
+                    self._peer_dead_until.pop(peer, None)
             url = 'http://{}/peercache/{}'.format(peer, digest)
             tmp = None
             nbytes = 0
@@ -1039,6 +1071,7 @@ class SharedRowGroupCache(CacheBase):
             except urllib.error.HTTPError as e:
                 if e.code != 404:    # 404 is an honest peer miss
                     self._bump('peer_errors', 'shared_peer_errors')
+                    self._mark_peer_dead(peer)
                     self._observe_peer_fetch(peer, attempt_start, 'error',
                                              nbytes)
                 else:
@@ -1049,6 +1082,7 @@ class SharedRowGroupCache(CacheBase):
                 logger.warning('peer-cache fetch %s failed (degrading to '
                                'next peer / local fill): %s', url, e)
                 self._bump('peer_errors', 'shared_peer_errors')
+                self._mark_peer_dead(peer)
                 self._observe_peer_fetch(peer, attempt_start, 'error',
                                          nbytes)
                 continue
@@ -1063,6 +1097,7 @@ class SharedRowGroupCache(CacheBase):
                 self._bump('peer_hits', 'shared_peer_hits')
                 with self._lock:
                     self._totals['peer_bytes'] += nbytes
+                    self._peer_dead_until.pop(peer, None)
                 self._observe_peer_fetch(peer, attempt_start, 'hit', nbytes)
                 return attached
             self._observe_peer_fetch(peer, attempt_start, 'miss', nbytes)

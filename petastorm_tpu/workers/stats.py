@@ -80,6 +80,16 @@ COUNTERS = (
                            # crash (exactly-once: deficit-checked first)
     'poison_items_quarantined',  # items quarantined after killing workers
                                  # repeatedly (no crash loop)
+    'peer_skipped_dead',  # peer-cache fetches skipped because the peer was
+                          # inside its dead-peer cooldown window
+    'hosts_joined',      # pod members admitted by the elasticity plane
+                         # (podelastic; docs/robustness.md)
+    'hosts_died',        # pod members declared dead (heartbeat expiry) —
+                         # a named degradation cause in /healthz
+    'leases_rebalanced',  # shard leases that moved to a different host
+                          # after a membership change
+    'rows_resumed',      # rows a takeover host resumed from a dead host's
+                         # checkpointed lease cursor (never re-delivered)
 )
 
 #: Occupancy gauges; each also keeps a ``<name>_max`` high-water mark.

@@ -33,7 +33,7 @@ import pytest  # noqa: E402
 _THREAD_GUARDED_MODULES = frozenset({
     'test_tracing', 'test_health', 'test_sharedcache', 'test_readahead',
     'test_workers_pool', 'test_transport', 'test_latency', 'test_autotune',
-    'test_chaos',
+    'test_chaos', 'test_podelastic',
 })
 
 #: Test modules that run under the lockdep-lite harness
@@ -43,7 +43,7 @@ _THREAD_GUARDED_MODULES = frozenset({
 #: production layer; ``ci/run_tests.sh`` runs these lanes with it on.
 _LOCKDEP_MODULES = frozenset({
     'test_sharedcache', 'test_health', 'test_workers_pool', 'test_latency',
-    'test_autotune', 'test_chaos',
+    'test_autotune', 'test_chaos', 'test_podelastic',
 })
 
 

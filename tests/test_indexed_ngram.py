@@ -329,7 +329,8 @@ def test_predicate_and_transform_resume_exact(tmp_path):
     state_at = 3
     resumed = make_indexed_ngram_loader(url, ngram, batch_size=4, **args)
     resumed.load_state_dict({'epoch': 3 // resumed.batches_per_epoch,
-                             'batch': 3 % resumed.batches_per_epoch})
+                             'batch': 3 % resumed.batches_per_epoch,
+                             'version': 1})
     got = list(resumed)
     assert len(got) == len(batches) - state_at
     for a, b in zip(batches[state_at:], got):

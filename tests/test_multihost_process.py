@@ -61,7 +61,8 @@ def _expected_stream(url, start=(0, 0)):
     from petastorm_tpu.indexed import IndexedBatchLoader, IndexedDatasetReader
     loader = IndexedBatchLoader(IndexedDatasetReader(url), BATCH,
                                 num_epochs=EPOCHS, seed=SEED, workers_count=1)
-    loader.load_state_dict({'epoch': start[0], 'batch': start[1]})
+    loader.load_state_dict({'epoch': start[0], 'batch': start[1],
+                            'version': 1})
     out = []
     for batch in loader:
         ids = np.ascontiguousarray(batch['id'].astype(np.int64))

@@ -1,0 +1,545 @@
+"""Elastic pod membership tests: lease plane, exactly-once certificate
+across host death/join, chaos determinism, kill switch, and the satellite
+hardenings (state-dict schema, shard validation, dead-peer cooldown).
+
+Runs on one machine: K in-process "hosts" share a coordination directory
+(``ElasticPodSim``), which is exactly how the CI chaos lane exercises pod
+elasticity (docs/robustness.md)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import ArrowListCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+from petastorm_tpu.faultfs import CHAOS_ENV_VAR, reset_chaos_cache
+from petastorm_tpu.indexed import IndexedBatchLoader, IndexedDatasetReader
+from petastorm_tpu.podelastic import (DEFAULT_TTL_BEATS, ELASTIC_ENV_VAR,
+                                      ElasticConfigError,
+                                      ElasticCoverageAuditor, ElasticPodSim,
+                                      LeaseLedger, LeasePlan, PodMembership,
+                                      rendezvous_assign,
+                                      resolve_elastic_shard)
+from petastorm_tpu.podobs import PodCertificateError
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+ROWS = 240
+BATCH = 8
+
+ElasticSchema = Unischema('ElasticSchema', [
+    UnischemaField('idx', np.int64, (), ScalarCodec(), False),
+    UnischemaField('vec', np.float32, (4,), ArrowListCodec(), False),
+])
+
+
+@pytest.fixture(scope='module')
+def elastic_dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('podelastic') / 'ds'
+    url = 'file://' + str(path)
+    rng = np.random.default_rng(3)
+    rows = [{'idx': np.int64(i),
+             'vec': rng.standard_normal(4).astype(np.float32)}
+            for i in range(ROWS)]
+    with materialize_dataset(url, ElasticSchema, row_group_size_mb=0.001) as w:
+        w.write_rows(rows)
+    return url
+
+
+@pytest.fixture
+def dataset(elastic_dataset):
+    ds = IndexedDatasetReader(elastic_dataset)
+    yield ds
+    ds.close()
+
+
+@pytest.fixture
+def no_chaos(monkeypatch):
+    monkeypatch.delenv(CHAOS_ENV_VAR, raising=False)
+    monkeypatch.delenv(ELASTIC_ENV_VAR, raising=False)
+    reset_chaos_cache()
+    yield
+    reset_chaos_cache()
+
+
+def _arm_chaos(monkeypatch, spec):
+    monkeypatch.setenv(CHAOS_ENV_VAR, spec)
+    reset_chaos_cache()
+
+
+def _run_pod(dataset, coord_root, k_hosts=3, seed=1, collect=None):
+    sim = ElasticPodSim(dataset, str(coord_root), k_hosts=k_hosts,
+                        batch_size=BATCH, seed=seed)
+    on_batch = None
+    if collect is not None:
+        on_batch = lambda cols, lease, batch: collect.append(  # noqa: E731
+            (lease, batch, np.asarray(cols['idx'], np.int64),
+             np.asarray(cols['vec'], np.float32)))
+    report = sim.run_epoch(0, on_batch=on_batch)
+    certificate = sim.certificate(0)
+    sim.close()
+    return sim, report, certificate
+
+
+# -- membership ----------------------------------------------------------------
+
+
+class TestMembership:
+    def test_needs_coord_root_loudly(self):
+        with pytest.raises(ElasticConfigError, match='NOT a membership'):
+            PodMembership('')
+
+    def test_register_observe_leave(self, tmp_path, no_chaos):
+        a = PodMembership(str(tmp_path), host_id='a')
+        b = PodMembership(str(tmp_path), host_id='b')
+        assert a.observe() == ('a', 'b')
+        assert a.counters['hosts_joined'] == 2
+        b.leave()
+        assert a.observe() == ('a',)
+        assert a.counters['hosts_died'] == 1
+
+    def test_counter_silence_is_death(self, tmp_path, no_chaos):
+        a = PodMembership(str(tmp_path), host_id='a', ttl_beats=2)
+        b = PodMembership(str(tmp_path), host_id='b', ttl_beats=2)
+        assert set(a.observe()) == {'a', 'b'}
+        # b stops beating; a's own beats advance past ttl_beats
+        for _ in range(DEFAULT_TTL_BEATS + 1):
+            a.beat()
+            a.observe()
+        assert a.observe() == ('a',)
+        assert a.counters['hosts_died'] == 1
+        # b resumes: counted as a (re-)join
+        b.beat()
+        assert a.observe() == ('a', 'b')
+        assert a.counters['hosts_joined'] == 3
+
+    def test_ttl_beats_validated(self, tmp_path):
+        with pytest.raises(ElasticConfigError, match='ttl_beats'):
+            PodMembership(str(tmp_path), ttl_beats=0)
+
+
+class TestRendezvous:
+    def test_deterministic_and_complete(self):
+        hosts = ['h0', 'h1', 'h2']
+        a1 = rendezvous_assign(16, hosts)
+        a2 = rendezvous_assign(16, list(reversed(hosts)))
+        assert a1 == a2
+        assert set(a1) == set(range(16))
+        assert set(a1.values()) <= set(hosts)
+
+    def test_bounded_rebalance_on_death(self):
+        hosts = ['h0', 'h1', 'h2']
+        before = rendezvous_assign(32, hosts)
+        after = rendezvous_assign(32, ['h0', 'h2'])
+        for lease, host in before.items():
+            if host != 'h1':
+                # only the dead host's leases move — everyone else's argmax
+                # is unchanged (the rendezvous property)
+                assert after[lease] == host
+
+    def test_bounded_rebalance_on_join(self):
+        before = rendezvous_assign(32, ['h0', 'h1'])
+        after = rendezvous_assign(32, ['h0', 'h1', 'h2'])
+        for lease, host in after.items():
+            if host != 'h2':
+                assert before[lease] == host
+
+
+# -- lease plan + ledger -------------------------------------------------------
+
+
+class TestLeasePlan:
+    def test_partition_covers_all_pieces(self, dataset):
+        plan = LeasePlan(dataset.row_offsets, BATCH, 2, seed=0)
+        pieces = sorted(p for lease in range(2)
+                        for p in plan.lease_pieces(lease))
+        assert pieces == list(range(len(dataset.pieces)))
+
+    def test_batch_rows_pure_function(self, dataset):
+        p1 = LeasePlan(dataset.row_offsets, BATCH, 2, seed=9)
+        p2 = LeasePlan(dataset.row_offsets, BATCH, 2, seed=9)
+        for lease in range(2):
+            for batch in range(p1.batches_per_lease(lease)):
+                np.testing.assert_array_equal(p1.batch_rows(lease, 0, batch),
+                                              p2.batch_rows(lease, 0, batch))
+        # rows stay inside the lease's span and epochs reshuffle
+        lo, hi = p1.lease_rows(1)
+        rows = p1.batch_rows(1, 0, 0)
+        assert rows.min() >= lo and rows.max() < hi
+        assert not np.array_equal(rows, p1.batch_rows(1, 1, 0))
+
+    def test_validation(self, dataset):
+        with pytest.raises(ElasticConfigError, match='num_leases'):
+            LeasePlan(dataset.row_offsets, BATCH, 0)
+        with pytest.raises(ElasticConfigError, match='exceeds'):
+            LeasePlan(dataset.row_offsets, BATCH, 10_000)
+        with pytest.raises(ElasticConfigError, match='batch_size'):
+            LeasePlan(dataset.row_offsets, 0, 1)
+
+
+class TestLeaseLedger:
+    def test_delivery_claim_is_a_fence(self, tmp_path):
+        ledger = LeaseLedger(str(tmp_path))
+        assert ledger.claim_delivery(0, 0, 0, 'a', BATCH, []) is True
+        # the second claimant (a takeover racing the dead host's landed
+        # write) must lose and skip — never re-deliver
+        assert ledger.claim_delivery(0, 0, 0, 'b', BATCH, []) is False
+        record = ledger.read_delivery(0, 0, 0)
+        assert record['host'] == 'a'
+
+    def test_resume_covers_claim_cursor_gap(self, tmp_path):
+        ledger = LeaseLedger(str(tmp_path))
+        # cursor says 2, but batch 4 was claimed before the holder died:
+        # resume must be 5 (claimed == delivered, never re-deliver)
+        ledger.checkpoint_lease(0, 'dead-host', 0, 2)
+        for batch in (0, 1, 4):
+            ledger.claim_delivery(0, 0, batch, 'dead-host', BATCH, [])
+        assert ledger.resume_batch(0, 0) == 5
+        # a fresh epoch ignores the stale cursor
+        assert ledger.resume_batch(0, 1) == 0
+
+
+# -- the exactly-once certificate ---------------------------------------------
+
+
+class TestAuditor:
+    def _deliver_all(self, plan, ledger, host='h'):
+        for lease in range(plan.num_leases):
+            for batch in range(plan.batches_per_lease(lease)):
+                ledger.claim_delivery(lease, 0, batch, host, BATCH, [])
+
+    def test_complete_epoch_certifies(self, dataset, tmp_path):
+        plan = LeasePlan(dataset.row_offsets, BATCH, 2, seed=0)
+        ledger = LeaseLedger(str(tmp_path))
+        self._deliver_all(plan, ledger)
+        audit = ElasticCoverageAuditor(plan, ledger,
+                                       pieces=dataset.pieces).audit_epoch(0)
+        assert audit['ok'] and not audit['problems']
+        assert audit['delivered_batches'] == plan.total_batches()
+
+    def test_drop_named_by_path_and_row_group(self, dataset, tmp_path):
+        plan = LeasePlan(dataset.row_offsets, BATCH, 2, seed=0)
+        ledger = LeaseLedger(str(tmp_path))
+        self._deliver_all(plan, ledger)
+        os.remove(os.path.join(str(tmp_path), 'delivered', 'l1_e0_b0.json'))
+        auditor = ElasticCoverageAuditor(plan, ledger,
+                                         pieces=dataset.pieces)
+        audit = auditor.audit_epoch(0)
+        assert not audit['ok']
+        assert any('#rg' in m for m in audit['missing'])
+        with pytest.raises(PodCertificateError, match='dropped'):
+            auditor.assert_complete(0)
+
+    def test_partial_pod_refuses_to_certify(self, dataset, tmp_path,
+                                            no_chaos):
+        plan = LeasePlan(dataset.row_offsets, BATCH, 2, seed=0)
+        ledger = LeaseLedger(str(tmp_path))
+        self._deliver_all(plan, ledger)
+        PodMembership(str(tmp_path), host_id='h')   # registers a record
+        auditor = ElasticCoverageAuditor(plan, ledger,
+                                         pieces=dataset.pieces)
+        assert auditor.audit_epoch(0, require_hosts=['h'])['ok']
+        audit = auditor.audit_epoch(0, require_hosts=['h', 'ghost'])
+        assert not audit['ok'] and audit['unreachable'] == ['ghost']
+        with pytest.raises(PodCertificateError, match='partial_pod'):
+            auditor.assert_complete(0, require_hosts=['ghost'])
+
+
+# -- pod runs: clean, host-death, host-join ------------------------------------
+
+
+class TestElasticPod:
+    def test_clean_epoch_exactly_once(self, dataset, tmp_path, no_chaos):
+        got = []
+        sim, report, certificate = _run_pod(dataset, tmp_path / 'c',
+                                            collect=got)
+        assert certificate['ok']
+        assert report['counters']['batches_delivered'] == \
+            sim.plan.total_batches()
+        rows = np.concatenate([g[2] for g in got])
+        assert len(rows) == len(np.unique(rows))    # no duplicates anywhere
+
+    def test_host_death_completes_on_survivors(self, dataset, tmp_path,
+                                               monkeypatch, no_chaos):
+        _arm_chaos(monkeypatch, 'host-death:42')
+        got = []
+        sim, report, certificate = _run_pod(dataset, tmp_path / 'd',
+                                            collect=got)
+        assert report['deaths'], 'chaos must have killed a host'
+        assert certificate['ok'], 'exactly-once across the rebalance'
+        assert report['counters']['leases_rebalanced'] >= 1
+        assert report['counters']['rows_resumed'] > 0
+        rows = np.concatenate([g[2] for g in got])
+        assert len(rows) == len(np.unique(rows))
+        # the dead host's cause is named in /healthz degraded causes
+        from petastorm_tpu.health import degradation_causes
+        snapshot = dict(report['counters'], dead_hosts=report['deaths'])
+        causes = degradation_causes(snapshot)
+        assert any('host-death' in c and report['deaths'][0] in c
+                   for c in causes), causes
+
+    def test_host_death_deterministic_replay(self, dataset, tmp_path,
+                                             monkeypatch, no_chaos):
+        from petastorm_tpu.faultfs import chaos_from_env
+        _arm_chaos(monkeypatch, 'host-death:42')
+        _, r1, _ = _run_pod(dataset, tmp_path / 'r1')
+        tallies1 = dict(chaos_from_env().injected)
+        _arm_chaos(monkeypatch, 'host-death:42')
+        _, r2, _ = _run_pod(dataset, tmp_path / 'r2')
+        tallies2 = dict(chaos_from_env().injected)
+        assert r1['deaths'] == r2['deaths']
+        assert r1['counters'] == r2['counters']
+        assert tallies1 == tallies2 == {'host_death': 1}
+
+    def test_host_death_same_rows_as_clean(self, dataset, tmp_path,
+                                           monkeypatch, no_chaos):
+        """The delivered row multiset is invariant under the membership
+        change: the (seed, epoch, lease) grids are pure functions, so a
+        takeover produces bit-identical batches."""
+        clean = []
+        _run_pod(dataset, tmp_path / 'a', collect=clean)
+        _arm_chaos(monkeypatch, 'host-death:42')
+        chaotic = []
+        _run_pod(dataset, tmp_path / 'b', collect=chaotic)
+        by_key = {(l, b): (i, v) for l, b, i, v in clean}
+        assert set(by_key) == {(l, b) for l, b, _, _ in chaotic}
+        for l, b, idx, vec in chaotic:
+            np.testing.assert_array_equal(by_key[(l, b)][0], idx)
+            np.testing.assert_array_equal(by_key[(l, b)][1], vec)
+
+    def test_host_join_rebalances_without_restart(self, dataset, tmp_path,
+                                                  monkeypatch, no_chaos):
+        _arm_chaos(monkeypatch, 'host-join:7')
+        got = []
+        sim, report, certificate = _run_pod(dataset, tmp_path / 'j',
+                                            collect=got)
+        assert report['joins'], 'chaos must have admitted a joiner'
+        assert certificate['ok']
+        assert report['counters']['leases_rebalanced'] >= 1
+        # no global restart: nothing was delivered twice or re-delivered
+        assert report['counters']['batches_skipped_claimed'] == 0 or \
+            certificate['ok']
+        rows = np.concatenate([g[2] for g in got])
+        assert len(rows) == len(np.unique(rows))
+        # the joiner actually delivered work
+        audit = report['audit']
+        assert audit['by_host'].get(report['joins'][0], 0) > 0
+
+
+# -- kill switch ---------------------------------------------------------------
+
+
+class TestKillSwitch:
+    def test_sim_refuses_when_killed(self, dataset, tmp_path, monkeypatch):
+        monkeypatch.setenv(ELASTIC_ENV_VAR, '0')
+        with pytest.raises(ElasticConfigError, match='kill switch'):
+            ElasticPodSim(dataset, str(tmp_path), k_hosts=2, batch_size=BATCH)
+
+    def test_no_files_no_threads_when_killed(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ELASTIC_ENV_VAR, '0')
+        threads_before = threading.active_count()
+        cur, count, membership = resolve_elastic_shard(
+            {'coord_root': str(tmp_path)}, None, None, False)
+        assert (cur, count, membership) == (None, None, None)
+        assert os.listdir(str(tmp_path)) == []      # not even members/
+        assert threading.active_count() == threads_before
+
+    def test_elastic_shard_assignment(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(ELASTIC_ENV_VAR, raising=False)
+        PodMembership(str(tmp_path), host_id='aaa')
+        cur, count, membership = resolve_elastic_shard(
+            {'coord_root': str(tmp_path), 'host_id': 'bbb'},
+            None, None, False)
+        assert (cur, count) == (1, 2)
+        assert membership.host_id == 'bbb'
+        membership.leave()
+
+    def test_mutual_exclusions(self, tmp_path):
+        with pytest.raises(ElasticConfigError, match='mutually exclusive'):
+            resolve_elastic_shard({'coord_root': str(tmp_path)}, 0, 2, False)
+        with pytest.raises(ElasticConfigError, match='shard_by_jax_process'):
+            resolve_elastic_shard({'coord_root': str(tmp_path)},
+                                  None, None, True)
+        with pytest.raises(ElasticConfigError, match='unknown elastic'):
+            resolve_elastic_shard({'coord_root': str(tmp_path), 'nope': 1},
+                                  None, None, False)
+        with pytest.raises(ElasticConfigError, match='coord_root'):
+            resolve_elastic_shard({}, None, None, False)
+
+
+# -- podobs integration --------------------------------------------------------
+
+
+class TestPodObsIntegration:
+    def test_certificate_checks_elastic_totals(self):
+        from petastorm_tpu.podobs import check_pod_certificate
+        good = check_pod_certificate({}, elastic_totals={
+            'batches_delivered': 10}, expected_batches=10)
+        assert good['ok'] and good['elastic']['checked']
+        dup = check_pod_certificate({}, elastic_totals={
+            'batches_delivered': 11}, expected_batches=10)
+        assert not dup['ok']
+        assert any('duplicate delivery' in p for p in dup['problems'])
+        drop = check_pod_certificate({}, elastic_totals={
+            'batches_delivered': 9}, expected_batches=10)
+        assert not drop['ok']
+        assert any('dropped delivery' in p for p in drop['problems'])
+
+    def test_merge_sums_elastic_sections(self, dataset, tmp_path, no_chaos,
+                                         monkeypatch):
+        _arm_chaos(monkeypatch, 'host-death:42')
+        sim = ElasticPodSim(dataset, str(tmp_path), k_hosts=3,
+                            batch_size=BATCH, seed=1)
+        sim.run_epoch(0)
+        from petastorm_tpu.podobs import PodObserver, make_observe_fn
+        snapshots = []
+        for host in sim.hosts:
+            observe = make_observe_fn(elastic_fn=host.elastic_snapshot,
+                                      host=host.host_id)
+            snapshots.append(observe())
+        observer = PodObserver(['x:1'],
+                               expected_batches=sim.plan.total_batches())
+        report = observer.merge(snapshots)
+        assert report['elastic']['totals']['batches_delivered'] == \
+            sim.plan.total_batches()
+        assert report['certificate']['ok']
+        assert report['certificate']['elastic']['checked']
+        # the denominator is NOT inflated by K hosts reporting the constant
+        assert report['certificate']['elastic']['expected_batches'] == \
+            sim.plan.total_batches()
+        observer.assert_certificate(report)
+        sim.close()
+
+    def test_flight_record_carries_elastic(self):
+        from petastorm_tpu.health import build_flight_record
+        record = build_flight_record({'state': 'healthy'}, {},
+                                     elastic={'hosts_died': 1})
+        assert record['elastic'] == {'hosts_died': 1}
+
+
+# -- satellite: resume-after-rebalance determinism (indexed loader) ------------
+
+
+class TestHandoffDeterminism:
+    def test_shard_handoff_bit_identical(self, elastic_dataset):
+        """An indexed-loader shard handed between two "hosts" mid-epoch
+        yields the same batches as an uninterrupted run, bit-compared —
+        the property that makes lease takeover exact."""
+        def make(ds):
+            return IndexedBatchLoader(ds, BATCH, num_epochs=1, seed=11,
+                                      workers_count=1)
+        ds_a = IndexedDatasetReader(elastic_dataset)
+        uninterrupted = [dict(b) for b in make(ds_a)]
+        # host A delivers 5 batches, checkpoints, "dies"
+        host_a = make(ds_a)
+        it = iter(host_a)
+        first = [dict(next(it)) for _ in range(5)]
+        state = host_a.state_dict()
+        it.close()
+        ds_a.close()
+        # host B resumes from the cursor in a fresh process-alike
+        ds_b = IndexedDatasetReader(elastic_dataset)
+        host_b = make(ds_b)
+        host_b.load_state_dict(state)
+        rest = [dict(b) for b in host_b]
+        ds_b.close()
+        got = first + rest
+        assert len(got) == len(uninterrupted)
+        for want, have in zip(uninterrupted, got):
+            np.testing.assert_array_equal(want['idx'], have['idx'])
+            np.testing.assert_array_equal(want['vec'], have['vec'])
+
+
+# -- satellite: state-dict schema hardening ------------------------------------
+
+
+class TestStateDictHardening:
+    def test_checkpointable_loader_rejects_garbage(self):
+        from petastorm_tpu.checkpoint import CheckpointableLoader
+        loader = CheckpointableLoader(lambda: iter(()))
+        assert loader.state_dict()['version'] == 1
+        with pytest.raises(ValueError, match="no 'version'"):
+            loader.load_state_dict({'epoch': 1, 'step': 2})
+        with pytest.raises(ValueError, match='Unknown checkpoint state'):
+            loader.load_state_dict({'epoch': 1, 'step': 2, 'version': 99})
+        with pytest.raises(ValueError, match='missing key'):
+            loader.load_state_dict({'epoch': 1, 'version': 1})
+        with pytest.raises(ValueError, match='must be a dict'):
+            loader.load_state_dict([1, 2, 3])
+        # the good path still round-trips
+        loader.load_state_dict({'epoch': 1, 'step': 2, 'version': 1})
+        assert loader.epoch == 1
+
+    def test_indexed_loader_rejects_garbage(self, elastic_dataset):
+        ds = IndexedDatasetReader(elastic_dataset)
+        loader = IndexedBatchLoader(ds, BATCH, seed=0, workers_count=1)
+        with pytest.raises(ValueError, match="no 'version'"):
+            loader.load_state_dict({'epoch': 0, 'batch': 1})
+        with pytest.raises(ValueError, match='Unknown state version'):
+            loader.load_state_dict({'epoch': 0, 'batch': 1, 'version': 2})
+        with pytest.raises(ValueError, match='missing key'):
+            loader.load_state_dict({'batch': 1, 'version': 1})
+        loader.load_state_dict({'epoch': 0, 'batch': 1, 'version': 1})
+        assert loader.batch == 1
+        ds.close()
+
+
+# -- satellite: factory shard validation ---------------------------------------
+
+
+class TestShardValidation:
+    def test_messages_name_both_values(self):
+        from petastorm_tpu.reader import _resolve_jax_shard
+        with pytest.raises(ValueError) as e:
+            _resolve_jax_shard(5, 3, False)
+        assert 'cur_shard=5' in str(e.value) and 'shard_count=3' in str(e.value)
+        with pytest.raises(ValueError, match='non-negative'):
+            _resolve_jax_shard(-1, 3, False)
+        with pytest.raises(ValueError, match='positive'):
+            _resolve_jax_shard(0, 0, False)
+        with pytest.raises(ValueError, match='specified together'):
+            _resolve_jax_shard(1, None, False)
+        assert _resolve_jax_shard(None, None, False) == (None, None)
+        assert _resolve_jax_shard(2, 3, False) == (2, 3)
+
+
+# -- satellite: peer-cache dead-peer cooldown ----------------------------------
+
+
+class TestDeadPeerCooldown:
+    def test_errored_peer_skipped_within_cooldown(self, tmp_path):
+        from petastorm_tpu.sharedcache import SharedRowGroupCache
+        cache = SharedRowGroupCache(str(tmp_path / 'cache'),
+                                    size_limit_bytes=1 << 20,
+                                    peers=['127.0.0.1:9'],  # discard port
+                                    peer_timeout_s=0.2,
+                                    peer_dead_cooldown_s=60.0)
+        try:
+            assert cache._peer_fetch('0' * 32) is None
+            totals = cache.counters()
+            assert totals['peer_errors'] == 1
+            assert totals['peer_skipped_dead'] == 0
+            # within the cooldown window the dead peer costs nothing
+            assert cache._peer_fetch('1' * 32) is None
+            totals = cache.counters()
+            assert totals['peer_errors'] == 1       # no second attempt
+            assert totals['peer_skipped_dead'] == 1
+        finally:
+            cache.close()
+
+    def test_cooldown_disabled_retries_every_time(self, tmp_path):
+        from petastorm_tpu.sharedcache import SharedRowGroupCache
+        cache = SharedRowGroupCache(str(tmp_path / 'cache'),
+                                    size_limit_bytes=1 << 20,
+                                    peers=['127.0.0.1:9'],
+                                    peer_timeout_s=0.2,
+                                    peer_dead_cooldown_s=0.0)
+        try:
+            cache._peer_fetch('0' * 32)
+            cache._peer_fetch('1' * 32)
+            totals = cache.counters()
+            assert totals['peer_errors'] == 2
+            assert totals['peer_skipped_dead'] == 0
+        finally:
+            cache.close()
