@@ -146,7 +146,8 @@ class MonotonicClockRule(Rule):
              'petastorm_tpu/readers/readahead.py',
              'petastorm_tpu/resilience.py', 'petastorm_tpu/faultfs.py',
              'petastorm_tpu/ops/decode.py', 'petastorm_tpu/objectstore.py',
-             'petastorm_tpu/podobs.py', 'petastorm_tpu/podelastic.py')
+             'petastorm_tpu/podobs.py', 'petastorm_tpu/podelastic.py',
+             'petastorm_tpu/goodput.py')
     _WALL_CALLS = ('time.time', 'datetime.now', 'datetime.datetime.now',
                    'datetime.utcnow', 'datetime.datetime.utcnow')
 

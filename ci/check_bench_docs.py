@@ -46,7 +46,7 @@ DEFAULT_DOCS = ('docs/benchmarks.md', 'docs/transport.md',
                 'docs/lineage.md', 'docs/cache.md', 'docs/profiling.md',
                 'docs/decode.md', 'docs/latency.md', 'docs/autotune.md',
                 'docs/robustness.md', 'docs/object_store.md',
-                'docs/pod_observability.md')
+                'docs/pod_observability.md', 'docs/goodput.md')
 MIN_ANNOTATIONS = 30
 
 #: Artifacts that MUST be quoted by at least one annotation across the
@@ -64,12 +64,13 @@ MIN_ANNOTATIONS = 30
 #: + pod-dedup record; round-19 adds BENCH_r19, the pod-observability
 #: overhead + K-host merged-certificate record; round-20 adds BENCH_r20,
 #: the elastic pod membership clean-path-overhead + host-death-recovery
-#: record).
+#: record; round-21 adds BENCH_r21, the goodput-plane overhead +
+#: stall-classification record).
 REQUIRED_ARTIFACTS = ('BENCH_r06.json', 'BENCH_r07.json', 'BENCH_r08.json',
                       'BENCH_r09.json', 'BENCH_r10.json', 'BENCH_r11.json',
                       'BENCH_r12.json', 'BENCH_r13.json', 'BENCH_r14.json',
                       'BENCH_r15.json', 'BENCH_r16.json', 'BENCH_r18.json',
-                      'BENCH_r19.json', 'BENCH_r20.json')
+                      'BENCH_r19.json', 'BENCH_r20.json', 'BENCH_r21.json')
 
 def check_artifacts_intact(root: str = ROOT):
     """Reject any committed ``BENCH_*.json`` that carries a ``parsed`` key

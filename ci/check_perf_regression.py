@@ -369,6 +369,29 @@ def _podobs_entries(artifact, round_no, blob):
     return entries
 
 
+def _goodput_entries(artifact, round_no, blob):
+    """Entries from the goodput-plane benchmark (r21): the goodput-off
+    baseline rate under the synthetic step loop, and the goodput-on rate
+    whose %-of-baseline IS the default-on overhead claim (its roofline
+    context)."""
+    entries = []
+    overhead = blob.get('overhead') or {}
+    config = {'platform': 'host', 'quick': bool(blob.get('quick')),
+              'rows': blob.get('rows'), 'pairs': overhead.get('pairs')}
+    baseline = overhead.get('baseline_items_per_s')
+    if isinstance(baseline, (int, float)):
+        entries.append(_entry(artifact, round_no,
+                              'goodput.baseline_items_per_s', config,
+                              baseline))
+    on_rate = overhead.get('goodput_on_items_per_s')
+    if isinstance(on_rate, (int, float)):
+        roof = blob.get('roofline') or {}
+        entries.append(_entry(artifact, round_no,
+                              'goodput.observed_items_per_s', config, on_rate,
+                              roofline_pct=roof.get('roofline_pct')))
+    return entries
+
+
 def _podelastic_entries(artifact, round_no, blob):
     """Entries from the elastic pod membership benchmark (r20): the
     lease-plane-off baseline under the recorded trace, the elastic-on
@@ -465,6 +488,8 @@ def normalize_artifact(name: str, blob: dict):
         entries.extend(_podobs_entries(name, round_no, payload))
     elif payload.get('benchmark', '') == 'podelastic':
         entries.extend(_podelastic_entries(name, round_no, payload))
+    elif payload.get('benchmark', '') == 'goodput':
+        entries.extend(_goodput_entries(name, round_no, payload))
     elif 'baseline_items_per_s' in payload:
         entries.extend(_overhead_entries(name, round_no, payload))
     elif 'shared' in payload and 'roofline' in payload:

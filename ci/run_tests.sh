@@ -125,6 +125,12 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_device_decode.py -q
 echo '== device-decode quick bench (kill-switch A/B, raw-shipping counters, probe ceilings) =='
 JAX_PLATFORMS=cpu python -m petastorm_tpu.benchmark.device_decode --quick
 
+echo '== goodput quick checks (step decomposition, explain_step, pod merge, kill switch; lockdep on) =='
+PETASTORM_TPU_LOCKDEP=1 JAX_PLATFORMS=cpu python -m pytest tests/test_goodput.py -q
+
+echo '== goodput quick bench (overhead A/B, slow-data vs slow-compute classification, pod straggler) =='
+JAX_PLATFORMS=cpu python -m petastorm_tpu.benchmark.goodput --quick
+
 echo '== bench-docs consistency gate =='
 python ci/check_bench_docs.py
 

@@ -109,6 +109,12 @@ _DEFAULT_OPTIONS = {
 #: constant the reader applies at construction (reader.py).
 VENT_EXTRA = 2
 
+#: Windowed ``data_stall_fraction`` (goodput plane) above which the sensor
+#: path proposes deepening io readahead: the device spent most of the tick
+#: window waiting on data, so widen the host side regardless of what the
+#: throughput model predicts.
+DATA_STALL_SENSOR_THRESHOLD = 0.5
+
 
 def resolve_autotune(autotune) -> Optional[dict]:
     """Resolve the ``autotune=`` kwarg against :data:`AUTOTUNE_ENV_VAR` into
@@ -350,6 +356,7 @@ class PipelineController:
         self._prev_snapshot: Optional[dict] = None
         self._prev_ts: Optional[float] = None
         self._last_rates: Dict[str, float] = {}
+        self._last_data_stall: Optional[float] = None
         # anti-flap state: knob -> tick until which it rests; (knob, dir) ->
         # tick until which that direction is quarantined
         self._cooldowns: Dict[str, int] = {}
@@ -398,7 +405,12 @@ class PipelineController:
 
     _DELTA_KEYS = ('items_out', 'worker_io_s', 'readahead_io_s',
                    'readahead_wait_s', 'worker_decode_s',
-                   'worker_publish_wait_s', 'queue_wait_s', 'bytes_moved')
+                   'worker_publish_wait_s', 'queue_wait_s', 'bytes_moved',
+                   # goodput plane seconds (docs/goodput.md): windowed so the
+                   # data_stall_fraction sensor reflects the CURRENT epoch,
+                   # not an hours-old cumulative average
+                   'goodput_total_s', 'goodput_stall_s', 'goodput_h2d_s',
+                   'goodput_device_s')
 
     def _sense(self) -> dict:
         now = self._clock()
@@ -427,12 +439,14 @@ class PipelineController:
                                      else snapshot.get('queue_wait_p99_s',
                                                        0.0))
         signals = bottleneck_signals(delta)
+        from petastorm_tpu.workers.stats import data_stall_fraction
         return {
             'window_s': window,
             'items_delta': items,
             'items_per_s': rate,
             'e2e_p99_s': e2e_p99,
             'signals': signals,
+            'data_stall_fraction': data_stall_fraction(delta),
             'snapshot_delta': delta,
         }
 
@@ -551,7 +565,10 @@ class PipelineController:
     def _sensor_candidates(self, sense: dict) -> List[dict]:
         """Moves the throughput model has no term for, driven directly by
         sensor evidence: a tail-stall verdict (queue-wait p99 dwarfing p50)
-        asks for a deeper results queue to absorb the bursts."""
+        asks for a deeper results queue to absorb the bursts, and a
+        data-stalled consumer (the goodput plane's windowed
+        ``data_stall_fraction`` — the device waited on data for most of
+        the window) asks for deeper io readahead to widen the host side."""
         out = []
         signals = sense['signals']
         bound = self._actuators.get_queue_bound()
@@ -567,6 +584,21 @@ class PipelineController:
                                 'predicted_gain_pct': None,
                                 'policy': 'sensor',
                                 'evidence': signals['bottleneck']})
+        stall = sense.get('data_stall_fraction')
+        if stall is not None and stall >= DATA_STALL_SENSOR_THRESHOLD:
+            from petastorm_tpu.readers.readahead import (AUTO_INITIAL_DEPTH,
+                                                         AUTO_MAX_DEPTH)
+            readahead = self._actuators.get_readahead()
+            ra_up = (readahead + 1 if readahead >= AUTO_INITIAL_DEPTH
+                     else AUTO_INITIAL_DEPTH)
+            if readahead < ra_up <= AUTO_MAX_DEPTH:
+                out.append({'knob': 'io_readahead', 'direction': 'up',
+                            'to': ra_up,
+                            'predicted_samples_per_s': None,
+                            'predicted_gain_pct': None,
+                            'policy': 'sensor',
+                            'evidence': 'data_stall_fraction={}'.format(
+                                round(stall, 4))})
         return out
 
     # -- actuation -------------------------------------------------------------
@@ -688,6 +720,7 @@ class PipelineController:
             return None     # first tick: baseline only
         self._grade_pending(sense)
         self._last_rates = {'items_per_s': sense['items_per_s']}
+        self._last_data_stall = sense.get('data_stall_fraction')
         # arbitration: publish our deficit, read back our CPU share
         calibration = self._get_calibration()
         cap = None
@@ -805,6 +838,8 @@ class PipelineController:
             }
         out['autotune_workers'] = self._actuators.get_workers()
         out['autotune_readahead_depth'] = self._actuators.get_readahead()
+        if self._last_data_stall is not None:
+            out['autotune_data_stall_fraction'] = self._last_data_stall
         if self._worker_cap is not None:
             out['autotune_worker_cap'] = self._worker_cap
         if last is not None:

@@ -431,7 +431,8 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
                         latency: Optional[dict] = None,
                         slo: Optional[dict] = None,
                         autotune: Optional[dict] = None,
-                        elastic: Optional[dict] = None) -> dict:
+                        elastic: Optional[dict] = None,
+                        goodput: Optional[dict] = None) -> dict:
     """Assemble the flight-recorder artifact: everything needed to diagnose
     a stall *after* the process is gone. JSON-able by construction.
     ``lineage`` (a tracker's ``flight_summary()``) adds the coverage audit
@@ -451,7 +452,10 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
     (``docs/autotune.md``); ``elastic`` (an ``ElasticHost.elastic_snapshot()``)
     records this host's pod-membership view — held leases, hosts joined/died,
     leases rebalanced — so a stall after a membership change is attributable
-    to the rebalance (``docs/robustness.md``)."""
+    to the rebalance (``docs/robustness.md``); ``goodput`` (a
+    ``GoodputMonitor.flight_summary()``) records the per-step goodput
+    decomposition and the last few step rings — whether the accelerator was
+    fed when the pipeline died (``docs/goodput.md``)."""
     record = {
         'kind': 'petastorm_tpu_flight_record',
         # deliberate wall clock: a human-facing artifact timestamp, never
@@ -479,6 +483,8 @@ def build_flight_record(verdict: dict, heartbeats: Dict[str, dict],
         record['autotune'] = autotune
     if elastic is not None:
         record['elastic'] = elastic
+    if goodput is not None:
+        record['goodput'] = goodput
     return record
 
 
@@ -651,6 +657,12 @@ class DebugServer:
       (:meth:`petastorm_tpu.podobs.PodObserver.report`) when this host
       acts as the aggregator (``PETASTORM_TPU_PODOBS_PEERS``); 404
       otherwise.
+    - ``GET /goodput`` — the per-step goodput summary
+      (:meth:`petastorm_tpu.goodput.GoodputMonitor.summary`): cumulative +
+      rolling-window goodput/data-stall fractions and the mergeable
+      summed-seconds state. 404 when the plane is off
+      (``PETASTORM_TPU_GOODPUT=0``); ``{'attached': False}`` until a loader
+      iterates.
     - ``GET /stacks`` — plain-text stack dump of every in-process thread.
 
     Requests are served on daemon threads (``ThreadingHTTPServer``);
@@ -667,7 +679,8 @@ class DebugServer:
                  slo_fn: Optional[Callable[[], dict]] = None,
                  autotune_fn: Optional[Callable[[], dict]] = None,
                  observe_fn: Optional[Callable[[], dict]] = None,
-                 podmetrics_fn: Optional[Callable[[], dict]] = None):
+                 podmetrics_fn: Optional[Callable[[], dict]] = None,
+                 goodput_fn: Optional[Callable[[], dict]] = None):
         self._evaluate_fn = evaluate_fn
         self._snapshot_fn = snapshot_fn or (lambda: {})
         self._heartbeats_fn = heartbeats_fn or (lambda: {})
@@ -677,6 +690,7 @@ class DebugServer:
         self._autotune_fn = autotune_fn
         self._observe_fn = observe_fn
         self._podmetrics_fn = podmetrics_fn
+        self._goodput_fn = goodput_fn
         self._requested_port = port
         self._prefix = prefix
         self._server = None
@@ -756,6 +770,8 @@ class DebugServer:
                             blob['coverage'] = outer._coverage_fn()
                         if outer._slo_fn is not None:
                             blob['slo'] = outer._slo_fn()
+                        if outer._goodput_fn is not None:
+                            blob['goodput'] = outer._goodput_fn()
                         self._reply(200, 'application/json',
                                     json.dumps(blob, default=str))
                     elif route == '/coverage':
@@ -812,6 +828,15 @@ class DebugServer:
                                         json.dumps(outer._podmetrics_fn(),
                                                    default=str),
                                         extra_headers=self._pod_headers())
+                    elif route == '/goodput':
+                        if outer._goodput_fn is None:
+                            self._reply(404, 'text/plain',
+                                        'the goodput plane is off for this '
+                                        'reader (PETASTORM_TPU_GOODPUT=0)\n')
+                        else:
+                            self._reply(200, 'application/json',
+                                        json.dumps(outer._goodput_fn(),
+                                                   default=str))
                     elif route == '/stacks':
                         stacks = thread_stacks()
                         body = '\n'.join('== {} ==\n{}'.format(name, stack)
@@ -823,7 +848,7 @@ class DebugServer:
                                     'unknown route {}; try /healthz /metrics '
                                     '/diagnostics /coverage /profile /slo '
                                     '/autotune /observe/snapshot /podmetrics '
-                                    '/stacks\n'.format(route))
+                                    '/goodput /stacks\n'.format(route))
                 except Exception as e:  # report, never kill the serve loop
                     logger.exception('debug endpoint request failed')
                     try:

@@ -30,6 +30,7 @@ from decimal import Decimal
 
 import numpy as np
 
+from petastorm_tpu.goodput import GoodputMonitor, goodput_enabled
 from petastorm_tpu.lineage import (LINEAGE_COLUMN, PACK_SHIFT, PROVENANCE_KEY,
                                    BatchProvenance, pack_rows)
 from petastorm_tpu.readers.shuffling_buffer import (
@@ -312,6 +313,17 @@ class JaxLoaderBase(object):
         #: Background lookahead window for :meth:`iter_prefetched`; subclass
         #: constructors overwrite it from their ``prefetch_depth`` knob.
         self.prefetch_depth = resolve_prefetch_depth(None)
+        #: Per-step goodput accounting
+        #: (:class:`~petastorm_tpu.goodput.GoodputMonitor`, None under
+        #: ``PETASTORM_TPU_GOODPUT=0``). The iteration loop feeds it every
+        #: step's ``infeed_wait``/train wall; the staging helpers feed it the
+        #: H2D dispatch time. Call ``loader.goodput.fence(outputs)`` inside
+        #: the step for the exact device/host split (docs/goodput.md).
+        self.goodput = (GoodputMonitor(stats=self.stats, tracer=self.tracer)
+                        if goodput_enabled() else None)
+        register = getattr(reader, 'register_goodput', None)
+        if register is not None and self.goodput is not None:
+            register(self.goodput)
 
     def iter_prefetched(self, sharding=None, to_device=True):
         """Iterate with a background lookahead of ``self.prefetch_depth``
@@ -325,9 +337,10 @@ class JaxLoaderBase(object):
         if to_device:
             return prefetch_to_device(iter(self), self.prefetch_depth,
                                       sharding=sharding, stats=self.stats,
-                                      tracer=self.tracer, health=self.health)
+                                      tracer=self.tracer, health=self.health,
+                                      goodput=self.goodput)
         return prefetch_batches(iter(self), self.prefetch_depth,
-                                health=self.health)
+                                health=self.health, stats=self.stats)
 
     def __iter__(self):
         if self._error is not None:
@@ -341,10 +354,11 @@ class JaxLoaderBase(object):
                            'in-memory caching (inmemory_cache_all=True).')
         self._in_iter = True
         tracer = self.tracer
+        goodput = self.goodput
         latency = getattr(self.stats, 'latency', None) \
             if self.stats is not None else None
         try:
-            if tracer is None and latency is None:
+            if tracer is None and latency is None and goodput is None:
                 for batch in self._iter_impl():
                     yield batch
             else:
@@ -361,6 +375,8 @@ class JaxLoaderBase(object):
                     if tracer is not None:
                         tracer.add_span('infeed_wait', 'consumer',
                                         fetch_start, now - fetch_start)
+                    if goodput is not None:
+                        goodput.note_fetch(now - fetch_start, batch)
                     step_start = now
                     yield batch
                     # the time the consumer held the generator suspended IS
@@ -373,6 +389,8 @@ class JaxLoaderBase(object):
                     if tracer is not None:
                         tracer.add_span('train_step', 'consumer', step_start,
                                         step_elapsed)
+                    if goodput is not None:
+                        goodput.finish_step(step_elapsed)
         except Exception as e:
             self._error = e
             raise
@@ -824,6 +842,15 @@ class ShardedJaxLoader(JaxLoaderBase):
         self._named_sharding = NamedSharding(mesh, self._pspec)
         self.stats = self._loader.stats
         self.prefetch_depth = self._loader.prefetch_depth
+        if self.goodput is not None:
+            # This loader drives the inner loader's _iter_impl directly,
+            # bypassing its instrumented __iter__ — the OUTER monitor is the
+            # live one. Share it (the staging sites below feed it) and
+            # re-register it over the inner loader's dormant registration.
+            self._loader.goodput = self.goodput
+            register = getattr(reader, 'register_goodput', None)
+            if register is not None:
+                register(self.goodput)
         # -- device-side decode (docs/decode.md "Device-side decode") ----------
         # This loader decodes POST-staging (jitted over the global sharded
         # arrays), so the inner loader's pad/transform stages would see the
@@ -896,9 +923,11 @@ class ShardedJaxLoader(JaxLoaderBase):
                 return
             stats = self._loader.stats
             tracer = self.tracer
+            goodput = self.goodput
             if self._ngram is not None:
                 yield {off: stage_to_global(cols, self._named_sharding,
-                                            stats=stats, tracer=tracer)
+                                            stats=stats, tracer=tracer,
+                                            goodput=goodput)
                        for off, cols in batch.items()}
             else:
                 if self._device_plans and stats is not None:
@@ -909,7 +938,8 @@ class ShardedJaxLoader(JaxLoaderBase):
                                   * len(planned))
                 yield stage_to_global(batch, self._named_sharding, stats=stats,
                                       tracer=tracer,
-                                      fused_fn=self._device_fused_fn)
+                                      fused_fn=self._device_fused_fn,
+                                      goodput=goodput)
 
 
 def _all_processes_ready(local_ready: bool) -> bool:
@@ -924,7 +954,7 @@ def _all_processes_ready(local_ready: bool) -> bool:
 
 
 def stage_to_global(batch, named_sharding, stats=None, tracer=None,
-                    fused_fn=None):
+                    fused_fn=None, goodput=None):
     """Assemble a host batch dict into global ``jax.Array``s over
     ``named_sharding``; device-incompatible (string/object) columns ride
     under ``batch['_host']`` untouched — the single definition of the
@@ -934,9 +964,11 @@ def stage_to_global(batch, named_sharding, stats=None, tracer=None,
     span. ``fused_fn`` (an ``ops.decode.build_fused_infeed`` program) runs
     over the assembled device dict — bytes-through decode plus any device
     ``TransformSpec``, jitted over the GLOBAL sharded arrays so the work
-    shards along the batch axis with the data."""
+    shards along the batch axis with the data. ``goodput`` (a
+    :class:`~petastorm_tpu.goodput.GoodputMonitor`) attributes the same
+    wall time to the current step's ``h2d_stage`` leg."""
     import jax
-    timed = stats is not None or tracer is not None
+    timed = stats is not None or tracer is not None or goodput is not None
     start = time.perf_counter() if timed else 0.0
     device, host = {}, {}
     for name, value in batch.items():
@@ -961,6 +993,8 @@ def stage_to_global(batch, named_sharding, stats=None, tracer=None,
             stats.record_latency('device_stage', elapsed)
         if tracer is not None:
             tracer.add_span('device_stage', 'device', start, elapsed)
+        if goodput is not None:
+            goodput.note_stage(elapsed)
     return device
 
 
@@ -1025,9 +1059,19 @@ def infeed_diagnosis(snapshot: dict, heartbeats=None,
         'rows_decoded_batched': snapshot.get('rows_decoded_batched', 0),
         'rows_decoded_percell': snapshot.get('rows_decoded_percell', 0),
         'batched_decode_fraction': batched_decode_fraction(snapshot),
-        'rows_decoded_device': snapshot.get('rows_decoded_device', 0),
-        'bytes_shipped_raw': snapshot.get('bytes_shipped_raw', 0),
-        'device_decode_fraction': device_decode_fraction(snapshot),
+        # ONE device-side block: decode placement, per-step goodput, and the
+        # prefetch ring together answer "is the accelerator actually fed?"
+        # without hunting across sections (docs/goodput.md).
+        'device': {
+            'rows_decoded_device': snapshot.get('rows_decoded_device', 0),
+            'bytes_shipped_raw': snapshot.get('bytes_shipped_raw', 0),
+            'device_decode_fraction': device_decode_fraction(snapshot),
+            'goodput_fraction': snapshot.get('goodput_fraction'),
+            'data_stall_fraction': snapshot.get('data_stall_fraction'),
+            'prefetch_occupancy': snapshot.get('prefetch_occupancy', 0),
+            'prefetch_occupancy_max': snapshot.get('prefetch_occupancy_max',
+                                                   0),
+        },
         'queue_wait_p50_s': round(snapshot.get('queue_wait_p50_s', 0.0), 6),
         'queue_wait_p99_s': round(snapshot.get('queue_wait_p99_s', 0.0), 6),
         'e2e_latency_p99_s': round(snapshot.get('e2e_latency_p99_s', 0.0), 6),
@@ -1124,7 +1168,7 @@ def epoch_cache_on_device(loader, sharding=None):
             yield batch
 
 
-def prefetch_batches(iterator, size=None, health=None):
+def prefetch_batches(iterator, size=None, health=None, stats=None):
     """Host-side lookahead WITHOUT device staging: a background thread keeps
     up to ``size`` numpy batches ready; the jitted step's own call performs
     the host→device transfer. ``health`` (a
@@ -1139,13 +1183,15 @@ def prefetch_batches(iterator, size=None, health=None):
     passing numpy straight into ``jit`` folds transfer+execute into one
     dispatch. Measured on a v5e LM bench (64×257 int32 batches, ~1ms steps):
     86-90% infeed overlap via ``prefetch_to_device`` vs ~99% via
-    ``prefetch_batches``."""
+    ``prefetch_batches``. ``stats`` (a ``ReaderStats``) gauges the live ring
+    depth as ``prefetch_occupancy`` — an empty ring at step boundaries is
+    the classic starving signal."""
     return _pipeline(iterator, resolve_prefetch_depth(size),
-                     lambda batch: batch, health=health)
+                     lambda batch: batch, health=health, stats=stats)
 
 
 def prefetch_to_device(iterator, size=None, sharding=None, stats=None,
-                       tracer=None, health=None, fused_fn=None):
+                       tracer=None, health=None, fused_fn=None, goodput=None):
     """Double-buffered host→device prefetch.
 
     Stages up to ``size`` batches ahead of the consumer on a background thread
@@ -1172,6 +1218,10 @@ def prefetch_to_device(iterator, size=None, sharding=None, stats=None,
         over each staged batch's device-compatible columns on the prefetch
         thread — bytes-through decode (+ device ``TransformSpec``) overlaps
         the consumer's compute exactly like the transfer it rides with.
+    :param goodput: optional :class:`~petastorm_tpu.goodput.GoodputMonitor`
+        (e.g. ``loader.goodput``); each transfer dispatch's wall time is
+        attributed to the in-flight step's ``h2d_stage`` leg (thread-safe —
+        the put runs on the prefetch thread).
     :param size: lookahead depth; ``None`` resolves the loader knob chain
         (``PETASTORM_TPU_PREFETCH_DEPTH``, else 2 — docs/readahead.md).
     """
@@ -1182,7 +1232,7 @@ def prefetch_to_device(iterator, size=None, sharding=None, stats=None,
         # _is_device_compatible reads dtype via getattr: global jax.Arrays must
         # NOT be round-tripped through np.asarray (device->host copy; crashes
         # on non-fully-addressable multi-host arrays).
-        timed = stats is not None or tracer is not None
+        timed = stats is not None or tracer is not None or goodput is not None
         start = time.perf_counter() if timed else 0.0
         if sharding is None:
             staged = jax.tree_util.tree_map(
@@ -1207,18 +1257,25 @@ def prefetch_to_device(iterator, size=None, sharding=None, stats=None,
                 stats.record_latency('device_stage', elapsed)
             if tracer is not None:
                 tracer.add_span('device_stage', 'device', start, elapsed)
+            if goodput is not None:
+                goodput.note_stage(elapsed)
         return staged
 
-    return _pipeline(iterator, size, put, health=health)
+    return _pipeline(iterator, size, put, health=health, stats=stats)
 
 
-def _pipeline(iterator, size, put, health=None):
-    """Shared producer-thread pipeline behind the two prefetchers."""
+def _pipeline(iterator, size, put, health=None, stats=None):
+    """Shared producer-thread pipeline behind the two prefetchers.
+    ``stats`` gauges the ring's live depth as ``prefetch_occupancy`` on
+    every enqueue/dequeue — the depth is read under the ring's condition
+    but the gauge is recorded OUTSIDE it (the stats lock must never nest
+    inside the ring lock)."""
     queue = collections.deque()
     done = object()
     cv = threading.Condition()
     state = {'error': None, 'finished': False}
     beat = health.beat if health is not None else None
+    gauge = stats.gauge if stats is not None else None
 
     def producer():
         try:
@@ -1238,7 +1295,10 @@ def _pipeline(iterator, size, put, health=None):
                     if state['finished']:
                         return
                     queue.append(staged)
+                    depth = len(queue)
                     cv.notify_all()
+                if gauge is not None:
+                    gauge('prefetch_occupancy', depth)
                 if beat is not None:
                     beat('loader-prefetch', 'idle')
         except Exception as e:  # propagate into the consumer
@@ -1259,11 +1319,16 @@ def _pipeline(iterator, size, put, health=None):
                 while not queue:
                     cv.wait()
                 item = queue.popleft()
+                # the done sentinel is not a buffered batch: the gauge must
+                # read 0 once the ring is drained, not count the marker
+                depth = len(queue) - (1 if queue and queue[-1] is done else 0)
                 cv.notify_all()
             if item is done:
                 if state['error'] is not None:
                     raise state['error']
                 return
+            if gauge is not None:
+                gauge('prefetch_occupancy', depth)
             yield item
     finally:
         with cv:

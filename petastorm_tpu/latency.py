@@ -63,10 +63,13 @@ LATENCY_ENV_VAR = 'PETASTORM_TPU_LATENCY'
 #: seq (see ``docs/latency.md``); ``io_range`` is one planned object-store
 #: range fetch (``ParallelRangeReader.fetch_range``, hedge+retry included);
 #: ``peer_fetch`` is one shared-cache peer HTTP fetch attempt (see
-#: ``docs/pod_observability.md``).
+#: ``docs/pod_observability.md``); ``device_step``/``host_overhead`` are the
+#: goodput plane's per-step decomposition of the train wall (fence time vs
+#: the rest — see ``docs/goodput.md``; ``host_overhead`` records only on
+#: fenced steps, where the split was actually measured).
 STAGES = ('io', 'decode', 'queue_wait', 'deserialize', 'infeed_wait',
           'train_step', 'device_stage', 'e2e_batch', 'io_range',
-          'peer_fetch')
+          'peer_fetch', 'device_step', 'host_overhead')
 
 #: ``ReaderStats`` time-stage names → latency stage fed from the same
 #: ``record_time`` call (worker-side observations).
@@ -505,8 +508,8 @@ class PipelineLatency:
 #: Recognized SLO target keys (the ``slo=dict(...)`` factory knob).
 SLO_TARGET_KEYS = ('p99_e2e_ms', 'p99_queue_wait_ms', 'min_samples_per_s',
                    'min_io_overlap_fraction', 'max_stall_episodes',
-                   'error_budget', 'budget_window', 'fail_healthz',
-                   'eval_interval_s', 'min_evaluations')
+                   'min_goodput', 'error_budget', 'budget_window',
+                   'fail_healthz', 'eval_interval_s', 'min_evaluations')
 
 #: Fraction of evaluations allowed to breach before the budget is spent.
 DEFAULT_ERROR_BUDGET = 0.01
@@ -563,6 +566,10 @@ def validate_slo_targets(targets: dict) -> dict:
         value = out.get(key)
         if value is not None and float(value) < 0:
             raise ValueError('{} must be >= 0, got {!r}'.format(key, value))
+    goodput = out.get('min_goodput')
+    if goodput is not None and not 0.0 <= float(goodput) <= 1.0:
+        raise ValueError('min_goodput is a fraction in [0, 1], got '
+                         '{!r}'.format(goodput))
     return out
 
 
@@ -666,6 +673,23 @@ class SLOMonitor:
                 else None,
                 'ok': ok}
             breached |= not ok
+
+        target = self.targets.get('min_goodput')
+        if target is not None:
+            # derived by ReaderStats.snapshot() once the goodput plane has
+            # closed a step; None (plane kill-switched, or no loader steps
+            # yet) skips loudly — same contract as the latency checks
+            measured = snapshot.get('goodput_fraction')
+            if measured is None:
+                checks['min_goodput'] = {'target': float(target),
+                                         'measured': None, 'ok': None}
+                skipped.append('min_goodput')
+            else:
+                ok = measured >= float(target)
+                checks['min_goodput'] = {'target': float(target),
+                                         'measured': round(measured, 4),
+                                         'ok': ok}
+                breached |= not ok
 
         target = self.targets.get('max_stall_episodes')
         if target is not None:

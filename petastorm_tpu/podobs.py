@@ -166,6 +166,7 @@ def make_observe_fn(snapshot_fn: Optional[Callable[[], dict]] = None,
                     cache_counters_fn: Optional[Callable[[], dict]] = None,
                     span_tail_fn: Optional[Callable[[], list]] = None,
                     elastic_fn: Optional[Callable[[], dict]] = None,
+                    goodput_fn: Optional[Callable[[], dict]] = None,
                     host: Optional[str] = None) -> Callable[[], dict]:
     """Build the ``observe_fn`` a ``DebugServer`` serves on
     :data:`SNAPSHOT_ROUTE`: one JSON-able dict with every per-host surface
@@ -205,6 +206,7 @@ def make_observe_fn(snapshot_fn: Optional[Callable[[], dict]] = None,
             'cache': _section(cache_counters_fn),
             'span_tail': _section(span_tail_fn),
             'elastic': _section(elastic_fn),
+            'goodput': _section(goodput_fn),
         }
         return snap
 
@@ -393,6 +395,84 @@ def check_pod_certificate(cache_totals: Optional[dict],
     return certificate
 
 
+def check_pod_goodput(goodput_by_host: Optional[Dict[str, Optional[dict]]],
+                      min_goodput: Optional[float] = None,
+                      unreachable: Sequence[str] = ()) -> dict:
+    """The pod goodput verdict from per-host ``/goodput`` summaries
+    (``GoodputMonitor.summary()`` shape): the pod fractions are re-derived
+    from the SUMMED per-host seconds — never averaged, so a straggler
+    cannot hide behind K-1 healthy hosts' means — and the worst-stalling
+    host is **named** as the straggler. ``min_goodput`` arms the check
+    (the same ``[0, 1]`` target the SLOMonitor takes); an unreachable host
+    makes the verdict uncheckable the way :func:`check_pod_certificate`'s
+    is — a named :data:`PARTIAL_POD` refusal, never a silent pass."""
+    totals = {'steps': 0, 'fenced_steps': 0, 'total_s': 0.0, 'stall_s': 0.0,
+              'h2d_s': 0.0, 'device_s': 0.0, 'host_s': 0.0}
+    by_host: Dict[str, dict] = {}
+    for host, section in sorted((goodput_by_host or {}).items()):
+        state = (section or {}).get('state') or {}
+        total = float(state.get('total_s', 0.0) or 0.0)
+        if total <= 0.0:
+            continue
+        stall = float(state.get('stall_s', 0.0) or 0.0)
+        h2d = float(state.get('h2d_s', 0.0) or 0.0)
+        device = float(state.get('device_s', 0.0) or 0.0)
+        totals['steps'] += int(state.get('steps', 0) or 0)
+        totals['fenced_steps'] += int(state.get('fenced_steps', 0) or 0)
+        totals['total_s'] += total
+        totals['stall_s'] += stall
+        totals['h2d_s'] += h2d
+        totals['device_s'] += device
+        totals['host_s'] += float(state.get('host_s', 0.0) or 0.0)
+        by_host[host] = {
+            'steps': int(state.get('steps', 0) or 0),
+            'goodput_fraction': round(device / total, 4),
+            'data_stall_fraction': round((stall + h2d) / total, 4),
+        }
+    pod_total = totals['total_s']
+    goodput_fraction = (round(totals['device_s'] / pod_total, 4)
+                        if pod_total > 0 else None)
+    data_stall_fraction = (
+        round((totals['stall_s'] + totals['h2d_s']) / pod_total, 4)
+        if pod_total > 0 else None)
+    straggler = None
+    if by_host:
+        worst = max(by_host, key=lambda h: by_host[h]['data_stall_fraction'])
+        straggler = dict(by_host[worst], host=worst)
+    problems: List[str] = []
+    unreachable = list(unreachable)
+    if unreachable:
+        problems.append(
+            '{}: {} host(s) unreachable ({}) — their step seconds are '
+            'missing from the sum; refusing to certify pod goodput'.format(
+                PARTIAL_POD, len(unreachable),
+                ', '.join(map(str, unreachable))))
+    checked = (min_goodput is not None and goodput_fraction is not None
+               and not unreachable)
+    if checked and goodput_fraction < float(min_goodput):  # type: ignore[arg-type]
+        detail = ''
+        if straggler is not None:
+            detail = (' — straggler {}: data_stall_fraction {}, '
+                      'goodput_fraction {}'.format(
+                          straggler['host'],
+                          straggler['data_stall_fraction'],
+                          straggler['goodput_fraction']))
+        problems.append('pod goodput {} below min_goodput {}{}'.format(
+            goodput_fraction, float(min_goodput), detail))
+    ok: Optional[bool]
+    if unreachable:
+        ok = False
+    elif checked:
+        ok = not problems
+    else:
+        ok = None   # no target or no data; never a silent pass
+    return {'goodput_fraction': goodput_fraction,
+            'data_stall_fraction': data_stall_fraction,
+            'totals': totals, 'by_host': by_host, 'straggler': straggler,
+            'min_goodput': min_goodput, 'unreachable': unreachable,
+            'checked': checked, 'ok': ok, 'problems': problems}
+
+
 # -- the aggregator -----------------------------------------------------------
 
 class PodObserver:
@@ -413,13 +493,18 @@ class PodObserver:
     def __init__(self, peers, timeout_s: float = DEFAULT_TIMEOUT_S,
                  expected_row_groups: Optional[int] = None,
                  trace_id: Optional[str] = None,
-                 expected_batches: Optional[int] = None):
+                 expected_batches: Optional[int] = None,
+                 min_goodput: Optional[float] = None):
         self.peers = parse_peers(peers)
         if not self.peers:
             raise ValueError('PodObserver needs at least one host:port peer')
         self.timeout_s = float(timeout_s)
         self.expected_row_groups = expected_row_groups
         self.expected_batches = expected_batches
+        #: Arms the pod goodput verdict (:func:`check_pod_goodput`): the
+        #: pod-wide goodput fraction (re-derived from summed seconds) must
+        #: meet this floor, with the straggler host named on breach.
+        self.min_goodput = min_goodput
         self.trace_id = trace_id or new_trace_id()
         self.last_report: Optional[dict] = None
 
@@ -480,6 +565,7 @@ class PodObserver:
         health_by_host: Dict[str, Optional[dict]] = {}
         stats_list, histogram_maps, cache_list = [], [], []
         elastic_list: List[Optional[dict]] = []
+        goodput_by_host: Dict[str, Optional[dict]] = {}
         slo_burns: Dict[str, float] = {}
         hard_breach_hosts: List[str] = []
         coverage_by_host = {}
@@ -500,6 +586,9 @@ class PodObserver:
             histogram_maps.append(snapshot.get('latency_histograms'))
             cache_list.append(snapshot.get('cache'))
             elastic_list.append(snapshot.get('elastic'))
+            goodput = snapshot.get('goodput')
+            if goodput is not None:
+                goodput_by_host[label] = goodput
             slo = snapshot.get('slo') or {}
             burn = slo.get('burn_rate')
             if isinstance(burn, (int, float)):
@@ -534,6 +623,9 @@ class PodObserver:
             unreachable=[u['peer'] for u in unreachable],
             elastic_totals=elastic_totals,
             expected_batches=self.expected_batches)
+        goodput = check_pod_goodput(
+            goodput_by_host, min_goodput=self.min_goodput,
+            unreachable=[u['peer'] for u in unreachable])
         verdict = PARTIAL_POD if unreachable else health['state']
         report = {
             'kind': 'petastorm_tpu.podmetrics',
@@ -563,6 +655,7 @@ class PodObserver:
                                     e for h, e in zip(hosts, elastic_list)
                                     if e is not None}},
             'certificate': certificate,
+            'goodput': goodput,
             'trace_tracks': trace_tracks,
         }
         self.last_report = report
@@ -625,6 +718,10 @@ def main(argv=None) -> int:
                         help='arm the decode-once certificate: the number '
                              'of distinct row groups the pod must have '
                              'decoded exactly once')
+    parser.add_argument('--min-goodput', type=float, default=None,
+                        help='arm the pod goodput verdict: the pod-wide '
+                             'goodput fraction (summed seconds, straggler '
+                             'named) must meet this [0, 1] floor')
     parser.add_argument('--trace-out', default=None,
                         help='also write the stitched pod chrome trace '
                              'to this path')
@@ -636,7 +733,8 @@ def main(argv=None) -> int:
         parser.error('no peers: pass host:port[,host:port...] or set '
                      '{}'.format(PODOBS_PEERS_ENV_VAR))
     observer = PodObserver(peers, timeout_s=args.timeout,
-                           expected_row_groups=args.expect_row_groups)
+                           expected_row_groups=args.expect_row_groups,
+                           min_goodput=args.min_goodput)
     report = observer.report()
     print(json.dumps(report, indent=None if args.compact else 2,
                      sort_keys=True, default=str))
@@ -651,6 +749,13 @@ def main(argv=None) -> int:
             observer.assert_certificate(report)
         except PodCertificateError as e:
             print(str(e), file=sys.stderr)
+            return 1
+    if args.min_goodput is not None:
+        goodput = report.get('goodput') or {}
+        if goodput.get('ok') is not True:
+            for problem in goodput.get('problems') or (
+                    'pod goodput unchecked: no host reported step data',):
+                print(problem, file=sys.stderr)
             return 1
     return 0
 

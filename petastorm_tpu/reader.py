@@ -554,6 +554,11 @@ class Reader:
         self._metrics_emitter = None
         self._watchdog = None
         self._debug_server = None
+        #: The loader-attached :class:`~petastorm_tpu.goodput.GoodputMonitor`
+        #: (``None`` until a JAX loader registers one, and always ``None``
+        #: under ``PETASTORM_TPU_GOODPUT=0``); serves ``/goodput`` and the
+        #: flight-record goodput section.
+        self._goodput = None
         self._flight_record_dir = flight_record_dir
         self.last_row_consumed = False
         # -- roofline profiler state (see docs/profiling.md) ------------------
@@ -871,6 +876,7 @@ class Reader:
             if stall_timeout:
                 self._watchdog.start()
         if resolved_debug_port is not None:
+            from petastorm_tpu.goodput import goodput_enabled
             from petastorm_tpu.podobs import podobs_enabled
             from petastorm_tpu.profiler import profiler_enabled
             observe_fn = None
@@ -891,7 +897,9 @@ class Reader:
                                  if self.lineage.enabled else None),
                     cache_counters_fn=getattr(cache, 'host_counters', None),
                     span_tail_fn=(tracer.tail if tracer is not None
-                                  else None))
+                                  else None),
+                    goodput_fn=(self._goodput_route if goodput_enabled()
+                                else None))
                 pod_peers = pod_peers_from_env()
                 if pod_peers:
                     podmetrics_fn = PodObserver(pod_peers).report
@@ -907,7 +915,9 @@ class Reader:
                 autotune_fn=(self._controller.report
                              if self._controller is not None else None),
                 observe_fn=observe_fn,
-                podmetrics_fn=podmetrics_fn)
+                podmetrics_fn=podmetrics_fn,
+                goodput_fn=(self._goodput_route if goodput_enabled()
+                            else None))
             try:
                 self._debug_server.start()
             except (OSError, OverflowError) as e:   # taken / out-of-range port
@@ -1171,6 +1181,9 @@ class Reader:
             'queue_depth_max': snapshot.get('queue_depth_max', 0),
             'shuffle_buffer_depth': snapshot.get('shuffle_buffer_depth', 0),
             'readahead_depth': snapshot.get('readahead_depth', 0),
+            'prefetch_occupancy': snapshot.get('prefetch_occupancy', 0),
+            'prefetch_occupancy_max': snapshot.get('prefetch_occupancy_max',
+                                                   0),
         }
         roofline = None
         if self._last_profile is not None:
@@ -1196,6 +1209,10 @@ class Reader:
                                      autotune=(
                                          self._controller.flight_summary()
                                          if self._controller is not None
+                                         else None),
+                                     goodput=(
+                                         self._goodput.flight_summary()
+                                         if self._goodput is not None
                                          else None))
         if path is None:
             import tempfile
@@ -1203,6 +1220,24 @@ class Reader:
             path = os.path.join(out_dir, 'petastorm_tpu_flight_{}_{}.json'
                                 .format(os.getpid(), int(time.time())))
         return write_flight_record(path, record)
+
+    # -- goodput plane (see docs/goodput.md) -----------------------------------
+
+    def register_goodput(self, monitor):
+        """Attach a loader's :class:`~petastorm_tpu.goodput.GoodputMonitor`
+        so the reader's surfaces (``/goodput``, ``/diagnostics``, flight
+        records, the pod observe snapshot) serve its per-step accounting.
+        The JAX loaders call this at construction; latest registration
+        wins (one live consumer loop per reader)."""
+        self._goodput = monitor
+
+    def _goodput_route(self):
+        """``GET /goodput`` source: the monitor's summary once a loader
+        registered one, else an explicit not-yet-attached marker (the
+        plane is on — a 404 would read as kill-switched)."""
+        if self._goodput is None:
+            return {'enabled': True, 'attached': False}
+        return self._goodput.summary()
 
     # -- roofline profiler (see docs/profiling.md) -----------------------------
 

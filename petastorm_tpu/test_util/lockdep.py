@@ -66,6 +66,7 @@ DEFAULT_TARGET_MODULES = (
     'petastorm_tpu.ops.decode',
     'petastorm_tpu.objectstore',
     'petastorm_tpu.podobs',
+    'petastorm_tpu.goodput',
 )
 
 

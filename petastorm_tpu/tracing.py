@@ -65,6 +65,30 @@ DEFAULT_CAPACITY = 100_000
 #: ``start_s`` is a ``time.perf_counter()`` reading; ``dur_s`` seconds.
 Span = Tuple[str, str, float, float, int, int, Optional[dict]]
 
+#: Category of the per-step goodput spans
+#: (:class:`~petastorm_tpu.goodput.GoodputMonitor` records one complete
+#: ``'step'`` span per training step, args carrying the verdict/stall ms).
+GOODPUT_STEP_CAT = 'goodput'
+
+
+def step_stall_marker(event: dict) -> Optional[dict]:
+    """An instant step-boundary marker for a data-stalled goodput step.
+
+    Given a chrome-trace ``'X'`` event, returns a process-scoped instant
+    (``ph='i'``) event at the step boundary naming the stall — Perfetto
+    renders these as flags, so stalled steps stand out on a busy pod
+    timeline without opening each span's args. ``None`` for every other
+    event. Used by both the single-host export and
+    :func:`stitch_pod_trace`."""
+    args = event.get('args') or {}
+    if (event.get('cat') != GOODPUT_STEP_CAT
+            or args.get('verdict') != 'data-stall'):
+        return None
+    return {'name': 'data-stall {}ms'.format(args.get('stall_ms')),
+            'cat': GOODPUT_STEP_CAT, 'ph': 'i', 's': 'p',
+            'ts': event['ts'], 'pid': event['pid'],
+            'tid': event.get('tid', 0), 'args': dict(args)}
+
 
 def resolve_trace(trace) -> Tuple[bool, Optional[str]]:
     """Resolve a factory's ``trace=`` kwarg against :data:`TRACE_ENV_VAR`.
@@ -189,6 +213,9 @@ class Tracer:
             if args:
                 event['args'] = args
             events.append(event)
+            marker = step_stall_marker(event)
+            if marker is not None:
+                events.append(marker)
         return events
 
     def export_chrome_trace(self, path: str) -> int:
@@ -259,6 +286,11 @@ def stitch_pod_trace(tracks: List[dict], path: str) -> str:
             if span.get('args'):
                 event['args'] = span['args']
             events.append(event)
+            marker = step_stall_marker(event)
+            if marker is not None:
+                # stalled step boundaries get a flag on the stitched pod
+                # timeline, already shifted onto the aggregator's clock
+                events.append(marker)
     events.sort(key=lambda e: (e['ph'] != 'M', e.get('ts', 0.0)))
     atomic_write(path, lambda f: json.dump(
         {'traceEvents': events, 'displayTimeUnit': 'ms'}, f))

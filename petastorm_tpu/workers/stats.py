@@ -38,6 +38,16 @@ TIME_STAGES = (
     'deserialize_s',     # transport frames -> payload (consumer side)
     'queue_wait_s',      # consumer blocked waiting for a result
     'device_stage_s',    # host -> device transfer (jax loaders)
+    # goodput plane (docs/goodput.md): per-training-step decomposition summed
+    # by the loader's GoodputMonitor. Additive seconds — pod aggregation sums
+    # them and re-derives the fractions, never averages fractions.
+    'goodput_total_s',   # consumer step wall (infeed wait + train wall)
+    'goodput_stall_s',   # pure data stall (fetch wait not covered by h2d)
+    'goodput_h2d_s',     # h2d staging seconds on the step's critical path
+    'goodput_device_s',  # device compute (fence wait; whole train wall when
+                         # unfenced)
+    'goodput_host_s',    # host-side overhead inside the train wall (fenced
+                         # steps only)
 )
 
 #: Monotonic counters.
@@ -95,8 +105,11 @@ COUNTERS = (
 #: Occupancy gauges; each also keeps a ``<name>_max`` high-water mark.
 #: ``shared_cache_bytes`` samples the host-wide tiered cache's approximate
 #: resident bytes (tier 0 + tier 1) as seen by this reader's workers.
+#: ``prefetch_occupancy`` samples the device-prefetch ring's buffered-batch
+#: count at every enqueue/dequeue — an empty ring at step boundaries is the
+#: classic starving signal (docs/goodput.md).
 GAUGES = ('queue_depth', 'shuffle_buffer_depth', 'readahead_depth',
-          'shared_cache_bytes')
+          'shared_cache_bytes', 'prefetch_occupancy')
 
 #: Derived keys added to every snapshot (not accumulated directly).
 #: ``items_per_s``/``mb_per_s`` are rates over the snapshot window — the time
@@ -109,6 +122,13 @@ GAUGES = ('queue_depth', 'shuffle_buffer_depth', 'readahead_depth',
 DERIVED = ('io_overlap_fraction', 'window_s', 'items_per_s', 'mb_per_s',
            'queue_wait_p50_s', 'queue_wait_p99_s', 'e2e_latency_p99_s',
            'io_range_p99_s', 'peer_fetch_p99_s')
+
+#: Conditionally-derived goodput keys (docs/goodput.md): present only once
+#: the goodput plane has closed at least one step (``goodput_total_s > 0``)
+#: — a snapshot must never read "0% goodput" for a pipeline that simply has
+#: no training loop attached. Fractions are re-derived from the summed
+#: seconds at every snapshot, never accumulated.
+GOODPUT_DERIVED = ('goodput_fraction', 'data_stall_fraction')
 
 #: Snapshot key carrying the raw per-stage histogram states (bucket-count
 #: pairs + sum/count) when the latency plane is on — what ``/metrics``
@@ -264,6 +284,12 @@ class ReaderStats:
             out['e2e_latency_p99_s'] = 0.0
             out['io_range_p99_s'] = 0.0
             out['peer_fetch_p99_s'] = 0.0
+        # goodput fractions: only once a training step closed — no loader
+        # (or the PETASTORM_TPU_GOODPUT=0 kill switch) means no keys at all
+        fraction = goodput_fraction(out)
+        if fraction is not None:
+            out['goodput_fraction'] = fraction
+            out['data_stall_fraction'] = data_stall_fraction(out)
         return out
 
 
@@ -341,6 +367,32 @@ def device_decode_fraction(snapshot: dict):
     if not total:
         return None
     return round(device / total, 4)
+
+
+def goodput_fraction(snapshot: dict):
+    """Fraction of consumer step wall time spent in device compute
+    (``goodput_device_s / goodput_total_s``; ``None`` before any training
+    step closed — an idle reader must not read as 0% goodput). Re-derived
+    from the summed seconds so pod aggregation (which sums the seconds
+    across hosts) yields the true pod fraction, not an average of per-host
+    fractions. See ``docs/goodput.md``."""
+    total = snapshot.get('goodput_total_s', 0.0)
+    if not total or total <= 0.0:
+        return None
+    return round(snapshot.get('goodput_device_s', 0.0) / total, 4)
+
+
+def data_stall_fraction(snapshot: dict):
+    """Fraction of consumer step wall time the device (or the unfenced
+    train loop) waited on data: pure pipeline stall plus the h2d staging
+    seconds on the critical path, over the step wall. Same ``None``
+    contract as :func:`goodput_fraction`."""
+    total = snapshot.get('goodput_total_s', 0.0)
+    if not total or total <= 0.0:
+        return None
+    stalled = (snapshot.get('goodput_stall_s', 0.0)
+               + snapshot.get('goodput_h2d_s', 0.0))
+    return round(stalled / total, 4)
 
 
 def recommend_io_readahead(snapshot: dict, max_depth: int = 8) -> int:

@@ -509,9 +509,13 @@ class TestEndToEnd:
     def test_infeed_diagnosis_carries_split(self, token_store, monkeypatch):
         _, snapshot, _, _ = _epoch_tokens(token_store, monkeypatch, True)
         diag = infeed_diagnosis(snapshot)
-        assert diag['rows_decoded_device'] == snapshot['rows_decoded_device']
-        assert diag['bytes_shipped_raw'] == snapshot['bytes_shipped_raw']
-        assert diag['device_decode_fraction'] == 1.0
+        device = diag['device']
+        assert device['rows_decoded_device'] == snapshot['rows_decoded_device']
+        assert device['bytes_shipped_raw'] == snapshot['bytes_shipped_raw']
+        assert device['device_decode_fraction'] == 1.0
+        assert 'goodput_fraction' in device
+        assert 'data_stall_fraction' in device
+        assert 'prefetch_occupancy' in device
 
     def test_fraction_derivation(self):
         assert device_decode_fraction({'rows_decoded_device': 3,
